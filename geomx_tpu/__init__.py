@@ -1,0 +1,36 @@
+"""geomx_tpu — a TPU-native geo-distributed training framework.
+
+A brand-new implementation of the capabilities of GeoMX (INET-RC/GeoMX, an
+MXNet fork with a Hierarchical Parameter Server), designed TPU-first:
+
+- intra-data-center aggregation lowers to XLA collectives (``psum`` under
+  ``pjit``/``shard_map``) over the ICI mesh instead of worker<->server traffic;
+- the global inter-data-center tier is an explicit host-side aggregation
+  service (the HiPS state machine) over a socket transport (Python or native
+  C++ van) — the TPU-era analogue of the reference's modified ps-lite;
+- WAN optimizations (Bi-Sparse sparsification, FP16/MPQ quantized
+  transmission, DGT priority channels, P3, TSEngine, MultiGPS) run as
+  jittable device kernels + host-side scheduling.
+
+User-facing surface mirrors the reference (``kv.create("dist_sync")``,
+``DMLC_*``/``ENABLE_*`` env vars, blocking server bootstrap on import) so the
+``examples/cnn*.py`` workloads run unchanged.
+
+Reference call-outs in docstrings cite files under ``/root/reference``
+(Lizonghang/GeoMX) as ``path:line``.
+"""
+
+__version__ = "0.1.0"
+
+from geomx_tpu import config  # noqa: F401
+from geomx_tpu import kvstore as kv  # noqa: F401  (mirrors mx.kv)
+from geomx_tpu import optimizer  # noqa: F401
+from geomx_tpu.kvstore import create  # noqa: F401
+
+# Mirror reference bootstrap: `import mxnet` on a node whose DMLC role is an
+# infrastructure role (scheduler / server / global_scheduler / global_server)
+# enters the blocking server loop and never returns to user code
+# (reference: python/mxnet/__init__.py:57 -> kvstore_server.py:77).
+from geomx_tpu import kvstore_server as _kvstore_server
+
+_kvstore_server._init_kvstore_server_module()

@@ -1,0 +1,186 @@
+"""Environment-variable configuration surface.
+
+The reference configures its whole topology and every feature toggle through
+environment variables (reference: docs/source/env-var-summary.rst:1-126, read
+in 3rdparty/ps-lite/src/postoffice.cc:22-53 and src/van.cc:427-477,613-629).
+We keep the same names so reference launch scripts translate 1:1, and add a
+small number of ``GEOMX_*`` vars for TPU-specific knobs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def env_str(name: str, default: str = "") -> str:
+    return os.environ.get(name, default)
+
+
+def env_int(name: str, default: int = 0) -> int:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return int(v)
+
+
+def env_float(name: str, default: float = 0.0) -> float:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return float(v)
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return v not in ("0", "false", "False", "")
+
+
+# Role constants (reference: postoffice.cc:22-53).
+ROLE_WORKER = "worker"
+ROLE_SERVER = "server"
+ROLE_SCHEDULER = "scheduler"
+ROLE_GLOBAL_SERVER = "global_server"
+ROLE_GLOBAL_SCHEDULER = "global_scheduler"
+
+INFRA_ROLES = (ROLE_SERVER, ROLE_SCHEDULER, ROLE_GLOBAL_SERVER, ROLE_GLOBAL_SCHEDULER)
+
+
+@dataclasses.dataclass
+class Config:
+    """Snapshot of the DMLC_*/ENABLE_*/MXNET_* environment.
+
+    Built fresh via :func:`load` so tests can mutate ``os.environ`` between
+    instantiations.
+    """
+
+    # ---- topology: local (intra-DC) tier ----
+    role: str = ""                      # DMLC_ROLE
+    ps_root_uri: str = "127.0.0.1"      # DMLC_PS_ROOT_URI
+    ps_root_port: int = 9091            # DMLC_PS_ROOT_PORT
+    num_workers: int = 1                # DMLC_NUM_WORKER
+    num_servers: int = 1                # DMLC_NUM_SERVER
+
+    # ---- topology: global (inter-DC) tier ----
+    role_global: str = ""               # DMLC_ROLE_GLOBAL
+    ps_global_root_uri: str = ""        # DMLC_PS_GLOBAL_ROOT_URI
+    ps_global_root_port: int = 0        # DMLC_PS_GLOBAL_ROOT_PORT
+    num_global_workers: int = 0         # DMLC_NUM_GLOBAL_WORKER
+    num_global_servers: int = 0         # DMLC_NUM_GLOBAL_SERVER
+    num_all_workers: int = 1            # DMLC_NUM_ALL_WORKER
+    is_master_worker: bool = False      # DMLC_ROLE_MASTER_WORKER
+    enable_central_worker: bool = True  # DMLC_ENABLE_CENTRAL_WORKER
+
+    # ---- node addressing ----
+    interface: str = ""                 # DMLC_INTERFACE
+    node_host: str = ""                 # DMLC_NODE_HOST
+    node_port: int = 0                  # PORT (0 = ephemeral)
+
+    # ---- feature toggles (reference: van.cc:539-549, 613-629) ----
+    enable_p3: bool = False             # ENABLE_P3
+    enable_dgt: int = 0                 # ENABLE_DGT in {0,1,2,3}
+    udp_channel_num: int = 0            # DMLC_UDP_CHANNEL_NUM
+    dgt_block_size: int = 4096          # DGT_BLOCK_SIZE
+    dgt_contri_alpha: float = 0.3       # DGT_CONTRI_ALPHA
+    dmlc_k: float = 0.8                 # DMLC_K (fraction of blocks sent reliably)
+    dmlc_k_min: float = 0.2             # DMLC_K_MIN
+    adaptive_k_flag: bool = False       # ADAPTIVE_K_FLAG
+    enable_intra_ts: bool = False       # ENABLE_INTRA_TS
+    enable_inter_ts: bool = False       # ENABLE_INTER_TS
+    max_greed_rate_ts: float = 0.9      # MAX_GREED_RATE_TS
+
+    # ---- algorithm knobs (reference: kvstore_dist_server.h:181-187) ----
+    use_hfa: bool = False               # MXNET_KVSTORE_USE_HFA
+    hfa_k1: int = 1                     # MXNET_KVSTORE_HFA_K1 (local steps)
+    hfa_k2: int = 1                     # MXNET_KVSTORE_HFA_K2 (global period)
+    size_lower_bound: int = 200000      # MXNET_KVSTORE_SIZE_LOWER_BOUND (MPQ)
+    bigarray_bound: int = 1000000       # MXNET_KVSTORE_BIGARRAY_BOUND
+
+    # ---- transport knobs ----
+    resend: bool = False                # PS_RESEND
+    resend_timeout_ms: int = 1000       # PS_RESEND_TIMEOUT
+    heartbeat_interval_s: int = 0       # PS_HEARTBEAT_INTERVAL (0 = off)
+    heartbeat_timeout_s: int = 60       # PS_HEARTBEAT_TIMEOUT
+    drop_rate: float = 0.0              # PS_DROP_MSG (fault injection)
+    verbose: int = 0                    # PS_VERBOSE
+
+    # ---- TPU-specific ----
+    van_type: str = "auto"              # GEOMX_VAN in {auto, python, native}
+    platform: str = ""                  # GEOMX_PLATFORM override for jax
+
+    @property
+    def is_worker(self) -> bool:
+        return self.role == ROLE_WORKER
+
+    @property
+    def is_server(self) -> bool:
+        return self.role == ROLE_SERVER
+
+    @property
+    def is_scheduler(self) -> bool:
+        return self.role == ROLE_SCHEDULER
+
+    @property
+    def is_global_server(self) -> bool:
+        return self.role_global == ROLE_GLOBAL_SERVER
+
+    @property
+    def is_global_scheduler(self) -> bool:
+        return self.role_global == ROLE_GLOBAL_SCHEDULER
+
+    @property
+    def has_global_tier(self) -> bool:
+        return bool(self.ps_global_root_uri) and self.num_global_servers > 0
+
+    @property
+    def is_distributed(self) -> bool:
+        return bool(self.role) or bool(self.role_global)
+
+
+def load() -> Config:
+    """Read the configuration from os.environ (reference: postoffice.cc:22-53)."""
+    return Config(
+        role=env_str("DMLC_ROLE"),
+        ps_root_uri=env_str("DMLC_PS_ROOT_URI", "127.0.0.1"),
+        ps_root_port=env_int("DMLC_PS_ROOT_PORT", 9091),
+        num_workers=env_int("DMLC_NUM_WORKER", 1),
+        num_servers=env_int("DMLC_NUM_SERVER", 1),
+        role_global=env_str("DMLC_ROLE_GLOBAL"),
+        ps_global_root_uri=env_str("DMLC_PS_GLOBAL_ROOT_URI"),
+        ps_global_root_port=env_int("DMLC_PS_GLOBAL_ROOT_PORT", 0),
+        num_global_workers=env_int("DMLC_NUM_GLOBAL_WORKER", 0),
+        num_global_servers=env_int("DMLC_NUM_GLOBAL_SERVER", 0),
+        num_all_workers=env_int("DMLC_NUM_ALL_WORKER", env_int("DMLC_NUM_WORKER", 1)),
+        is_master_worker=env_bool("DMLC_ROLE_MASTER_WORKER"),
+        enable_central_worker=env_bool("DMLC_ENABLE_CENTRAL_WORKER", True),
+        interface=env_str("DMLC_INTERFACE"),
+        node_host=env_str("DMLC_NODE_HOST"),
+        node_port=env_int("PORT", 0),
+        enable_p3=env_bool("ENABLE_P3"),
+        enable_dgt=env_int("ENABLE_DGT", 0),
+        udp_channel_num=env_int("DMLC_UDP_CHANNEL_NUM", 0),
+        dgt_block_size=env_int("DGT_BLOCK_SIZE", 4096),
+        dgt_contri_alpha=env_float("DGT_CONTRI_ALPHA", 0.3),
+        dmlc_k=env_float("DMLC_K", 0.8),
+        dmlc_k_min=env_float("DMLC_K_MIN", 0.2),
+        adaptive_k_flag=env_bool("ADAPTIVE_K_FLAG"),
+        enable_intra_ts=env_bool("ENABLE_INTRA_TS"),
+        enable_inter_ts=env_bool("ENABLE_INTER_TS"),
+        max_greed_rate_ts=env_float("MAX_GREED_RATE_TS", 0.9),
+        use_hfa=env_bool("MXNET_KVSTORE_USE_HFA"),
+        hfa_k1=env_int("MXNET_KVSTORE_HFA_K1", 1),
+        hfa_k2=env_int("MXNET_KVSTORE_HFA_K2", 1),
+        size_lower_bound=env_int("MXNET_KVSTORE_SIZE_LOWER_BOUND", 200000),
+        bigarray_bound=env_int("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000),
+        resend=env_bool("PS_RESEND"),
+        resend_timeout_ms=env_int("PS_RESEND_TIMEOUT", 1000),
+        heartbeat_interval_s=env_int("PS_HEARTBEAT_INTERVAL", 0),
+        heartbeat_timeout_s=env_int("PS_HEARTBEAT_TIMEOUT", 60),
+        drop_rate=env_float("PS_DROP_MSG", 0.0),
+        verbose=env_int("PS_VERBOSE", 0),
+        van_type=env_str("GEOMX_VAN", "auto"),
+        platform=env_str("GEOMX_PLATFORM"),
+    )

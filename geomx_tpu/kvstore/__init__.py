@@ -1,0 +1,4 @@
+"""geomx_tpu.kvstore — placeholder (real implementation landing next)."""
+
+def create(name="local"):
+    raise NotImplementedError("kvstore under construction")

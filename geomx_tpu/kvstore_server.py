@@ -1,0 +1,4 @@
+"""Server bootstrap on import — placeholder."""
+
+def _init_kvstore_server_module():
+    pass

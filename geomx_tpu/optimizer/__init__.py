@@ -1,0 +1,1 @@
+"""geomx_tpu.optimizer — placeholder (real implementation landing next)."""

@@ -1,0 +1,91 @@
+"""Per-app request/response tracking and receive-thread dispatch.
+
+Plays the role of ps-lite's ``Customer`` (reference:
+3rdparty/ps-lite/include/ps/internal/customer.h:27-128, src/customer.cc):
+each application object (KVWorker / KVServer) owns one Customer; the van
+routes inbound messages to ``accept``; a dedicated processing thread invokes
+the app's receive handler; request timestamps are matched against expected
+response counts so ``wait`` can block until completion.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, Optional
+
+from geomx_tpu.ps.message import Message
+
+
+class Customer:
+    def __init__(
+        self,
+        app_id: int,
+        customer_id: int,
+        recv_handle: Callable[[Message], None],
+    ):
+        self.app_id = app_id
+        self.customer_id = customer_id
+        self.recv_handle = recv_handle
+        self._queue: "queue.Queue[Optional[Message]]" = queue.Queue()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # ts -> [num_expected, num_received]
+        self._tracker: Dict[int, list] = {}
+        self._next_ts = 0
+        self._thread = threading.Thread(
+            target=self._receiving, name=f"customer-{app_id}-{customer_id}", daemon=True
+        )
+        self._thread.start()
+
+    # -- request lifecycle (reference: customer.h:66-90) -----------------
+
+    def new_request(self, num_responses: int) -> int:
+        with self._lock:
+            ts = self._next_ts
+            self._next_ts += 1
+            self._tracker[ts] = [num_responses, 0]
+            return ts
+
+    def wait_request(self, ts: int, timeout: Optional[float] = None) -> None:
+        """Block until all responses for ``ts`` arrived.
+
+        Completed entries are dropped from the tracker here (the reference
+        keeps them forever, customer.cc — a leak we don't reproduce); waiting
+        again on an already-completed ts returns immediately.
+        """
+        with self._cv:
+            if not self._cv.wait_for(
+                lambda: ts not in self._tracker
+                or self._tracker[ts][1] >= self._tracker[ts][0],
+                timeout,
+            ):
+                raise TimeoutError(f"wait_request(ts={ts}) timed out")
+            self._tracker.pop(ts, None)
+
+    def num_response(self, ts: int) -> int:
+        with self._lock:
+            return self._tracker.get(ts, [0, 0])[1]
+
+    def add_response(self, ts: int, n: int = 1) -> None:
+        with self._cv:
+            if ts in self._tracker:
+                self._tracker[ts][1] += n
+                self._cv.notify_all()
+
+    # -- inbound ---------------------------------------------------------
+
+    def accept(self, msg: Message) -> None:
+        self._queue.put(msg)
+
+    def _receiving(self) -> None:
+        while True:
+            msg = self._queue.get()
+            if msg is None:
+                return
+            self.recv_handle(msg)
+            if not msg.meta.request and msg.meta.timestamp >= 0:
+                self.add_response(msg.meta.timestamp)
+
+    def stop(self) -> None:
+        self._queue.put(None)

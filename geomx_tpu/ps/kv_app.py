@@ -1,0 +1,306 @@
+"""KVWorker / KVServer: the key-value application layer.
+
+Plays the role of ps-lite's ``KVWorker``/``KVServer``/``SimpleApp``
+(reference: 3rdparty/ps-lite/include/ps/kv_app.h:80-751) with a cleaner
+shape enabled by the two-postoffice design:
+
+- the reference's server-side global-tier client verbs (``TS_Push`` /
+  ``TS_Pull``, kv_app.h:508/533) are unnecessary — an intra-DC server simply
+  owns a regular :class:`KVWorker` bound to the *global* tier's postoffice;
+- SimpleApp command traffic (kv_app.h's SimpleApp) is folded in as messages
+  with ``meta.simple_app=True`` handled by the same request handler.
+
+Values travel as one data part per key with dtype/shape in the meta, so no
+lens bookkeeping is needed; compressed payloads tag ``meta.compr``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from geomx_tpu.ps import base
+from geomx_tpu.ps.customer import Customer
+from geomx_tpu.ps.message import Message, Meta
+from geomx_tpu.ps.postoffice import Postoffice
+
+KV_APP_ID = 0
+
+
+@dataclasses.dataclass
+class KVPairs:
+    """keys + one value array per key (reference: kv_app.h:39-77)."""
+
+    keys: List[int] = dataclasses.field(default_factory=list)
+    vals: List[np.ndarray] = dataclasses.field(default_factory=list)
+    # optional per-key auxiliary arrays (e.g. BSC indices)
+    aux: List[Optional[np.ndarray]] = dataclasses.field(default_factory=list)
+    compr: str = ""
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+
+@dataclasses.dataclass
+class ReqMeta:
+    """What a server request handler needs to respond (kv_app.h:444-462)."""
+
+    sender: int
+    timestamp: int
+    customer_id: int
+    push: bool
+    pull: bool
+    simple_app: bool
+    head: int
+    body: str
+    priority: int
+    version: int
+    iters: int
+    compr: str
+    num_merge: int
+
+
+def _pack_kv(meta: Meta, kvs: KVPairs) -> Message:
+    msg = Message(meta=meta)
+    msg.add_array(np.asarray(kvs.keys, dtype=np.int64))
+    aux_mask = []
+    for i, v in enumerate(kvs.vals):
+        msg.add_array(np.asarray(v))
+        a = kvs.aux[i] if i < len(kvs.aux) else None
+        if a is not None:
+            msg.add_array(np.asarray(a))
+            aux_mask.append(1)
+        else:
+            aux_mask.append(0)
+    msg.meta.compr = kvs.compr
+    if any(aux_mask):
+        msg.meta.aux_mask = int("".join(map(str, aux_mask)), 2)
+        msg.meta.aux_len = len(aux_mask)
+    return msg
+
+
+def _unpack_kv(msg: Message) -> KVPairs:
+    arrays = msg.arrays()
+    keys = [int(k) for k in arrays[0]] if len(arrays) else []
+    kvs = KVPairs(keys=keys, compr=msg.meta.compr)
+    nkeys = len(keys)
+    if msg.meta.aux_len and msg.meta.aux_mask:
+        # aux arrays interleaved after their value part
+        bits = bin(msg.meta.aux_mask)[2:].zfill(msg.meta.aux_len)
+        idx = 1
+        for i in range(nkeys):
+            kvs.vals.append(arrays[idx])
+            idx += 1
+            if bits[i] == "1":
+                kvs.aux.append(arrays[idx])
+                idx += 1
+            else:
+                kvs.aux.append(None)
+    else:
+        kvs.vals = arrays[1:1 + nkeys]
+        kvs.aux = [None] * nkeys
+    return kvs
+
+
+class KVWorker:
+    """Worker-side async push/pull client (reference: kv_app.h:80-426)."""
+
+    def __init__(self, postoffice: Postoffice, customer_id: int = 0):
+        self.po = postoffice
+        self.customer = Customer(KV_APP_ID, customer_id, self._process)
+        self.po.register_customer(self.customer)
+        self._lock = threading.Lock()
+        # ts -> list of response KVPairs
+        self._responses: Dict[int, List[KVPairs]] = {}
+        self._callbacks: Dict[int, Callable[[], None]] = {}
+
+    # -- public API ------------------------------------------------------
+
+    def push(
+        self,
+        kvs: KVPairs,
+        server_rank: int,
+        *,
+        cmd: int = 0,
+        priority: int = 0,
+        version: int = 0,
+        iters: int = 0,
+        num_merge: int = 1,
+        pull: bool = False,
+    ) -> int:
+        """ZPush (reference: kv_app.h:219). Response = 1 server ack."""
+        ts = self.customer.new_request(1)
+        meta = Meta(
+            recver=base.server_rank_to_id(server_rank),
+            app_id=KV_APP_ID,
+            customer_id=self.customer.customer_id,
+            timestamp=ts,
+            request=True,
+            push=True,
+            pull=pull,
+            head=cmd,
+            priority=priority,
+            version=version,
+            iters=iters,
+            num_merge=num_merge,
+        )
+        self.po.van.send(_pack_kv(meta, kvs))
+        return ts
+
+    def pull(
+        self,
+        keys: List[int],
+        server_rank: int,
+        *,
+        cmd: int = 0,
+        priority: int = 0,
+        cb: Optional[Callable[[], None]] = None,
+    ) -> int:
+        """ZPull (reference: kv_app.h:324)."""
+        ts = self.customer.new_request(1)
+        with self._lock:
+            self._responses[ts] = []
+            if cb is not None:
+                self._callbacks[ts] = cb
+        meta = Meta(
+            recver=base.server_rank_to_id(server_rank),
+            app_id=KV_APP_ID,
+            customer_id=self.customer.customer_id,
+            timestamp=ts,
+            request=True,
+            push=False,
+            pull=True,
+            head=cmd,
+            priority=priority,
+        )
+        kvs = KVPairs(keys=list(keys), vals=[np.zeros(0, np.float32)] * len(keys))
+        self.po.van.send(_pack_kv(meta, kvs))
+        return ts
+
+    def request(self, head: int, body: str, recver: int) -> int:
+        """SimpleApp-style command (reference: simple_app.h via kv_app.h)."""
+        n = (
+            len(base.expand_group(recver, self.po.num_workers, self.po.num_servers))
+            if base.is_group(recver)
+            else 1
+        )
+        ts = self.customer.new_request(n)
+        meta = Meta(
+            recver=recver,
+            app_id=KV_APP_ID,
+            customer_id=self.customer.customer_id,
+            timestamp=ts,
+            request=True,
+            simple_app=True,
+            head=head,
+            body=body,
+        )
+        self.po.van.send(Message(meta=meta))
+        return ts
+
+    def wait(self, ts: int, timeout: Optional[float] = None) -> None:
+        self.customer.wait_request(ts, timeout)
+
+    def take_response(self, ts: int) -> List[KVPairs]:
+        with self._lock:
+            return self._responses.pop(ts, [])
+
+    # -- inbound ---------------------------------------------------------
+
+    def _process(self, msg: Message) -> None:
+        if msg.meta.request:
+            # workers normally receive only responses; TSEngine relay traffic
+            # arrives here when a request handle is registered
+            if self._request_handle is not None:
+                self._request_handle(_req_meta_of(msg), _unpack_kv(msg), self)
+            return
+        ts = msg.meta.timestamp
+        if msg.meta.pull and msg.data:
+            kvs = _unpack_kv(msg)
+            with self._lock:
+                self._responses.setdefault(ts, []).append(kvs)
+        cb = self._callbacks.pop(ts, None) if self._callbacks else None
+        if cb is not None:
+            cb()
+
+    _request_handle: Optional[Callable] = None
+
+    def set_request_handle(self, fn: Callable) -> None:
+        """TSEngine worker-to-worker relay receive (kvstore_dist.h:58)."""
+        self._request_handle = fn
+
+    def response(self, req: ReqMeta, kvs: Optional[KVPairs] = None) -> None:
+        _send_response(self.po, self.customer, req, kvs)
+
+    def stop(self) -> None:
+        self.po.deregister_customer(self.customer)
+        self.customer.stop()
+
+
+class KVServer:
+    """Server-side request handler + responder (reference: kv_app.h:428-751)."""
+
+    def __init__(self, postoffice: Postoffice, customer_id: int = 0):
+        self.po = postoffice
+        self.customer = Customer(KV_APP_ID, customer_id, self._process)
+        self.po.register_customer(self.customer)
+        self._request_handle: Optional[Callable] = None
+
+    def set_request_handle(self, fn: Callable) -> None:
+        self._request_handle = fn
+
+    def _process(self, msg: Message) -> None:
+        if not msg.meta.request:
+            return  # servers make no requests through this customer
+        if self._request_handle is None:
+            return
+        self._request_handle(_req_meta_of(msg), _unpack_kv(msg), self)
+
+    def response(self, req: ReqMeta, kvs: Optional[KVPairs] = None) -> None:
+        _send_response(self.po, self.customer, req, kvs)
+
+    def stop(self) -> None:
+        self.po.deregister_customer(self.customer)
+        self.customer.stop()
+
+
+def _req_meta_of(msg: Message) -> ReqMeta:
+    return ReqMeta(
+        sender=msg.meta.sender,
+        timestamp=msg.meta.timestamp,
+        customer_id=msg.meta.customer_id,
+        push=msg.meta.push,
+        pull=msg.meta.pull,
+        simple_app=msg.meta.simple_app,
+        head=msg.meta.head,
+        body=msg.meta.body,
+        priority=msg.meta.priority,
+        version=msg.meta.version,
+        iters=msg.meta.iters,
+        compr=msg.meta.compr,
+        num_merge=msg.meta.num_merge,
+    )
+
+
+def _send_response(
+    po: Postoffice, customer: Customer, req: ReqMeta, kvs: Optional[KVPairs]
+) -> None:
+    meta = Meta(
+        recver=req.sender,
+        app_id=KV_APP_ID,
+        customer_id=req.customer_id,
+        timestamp=req.timestamp,
+        request=False,
+        push=req.push,
+        pull=req.pull,
+        simple_app=req.simple_app,
+        head=req.head,
+    )
+    if kvs is not None:
+        msg = _pack_kv(meta, kvs)
+    else:
+        msg = Message(meta=meta)
+    po.van.send(msg)

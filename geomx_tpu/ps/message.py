@@ -1,0 +1,294 @@
+"""Wire format: nodes, meta, messages, and binary framing.
+
+Plays the role of ps-lite's ``Message``/``Meta`` (reference:
+3rdparty/ps-lite/include/ps/internal/message.h:135-267) and its protobuf
+serialization (src/meta.proto, van.cc:1002-1126 PackMeta/UnpackMeta), but
+re-designed: a frame is
+
+    u32 magic | i32 recver | u8 flags | i32 priority | u32 meta_len |
+    meta (JSON, utf-8) | u32 ndata | { u32 len | bytes } * ndata
+
+The fixed preheader carries exactly the fields a router needs (destination,
+tier, priority) so the native C++ van can route frames without parsing JSON.
+Tensor payloads travel as raw little-endian buffers described by
+``dtypes``/``shapes`` entries in the meta.
+
+GeoMX-specific meta extensions are kept: DGT block fields (first_key, seq,
+seq_begin, seq_end, val_bytes, total_bytes, channel, tos — reference
+message.h:237-267), TSEngine control verbs (ASKPULL/ASKPUSH/REPLY/
+AUTOPULLREPLY — message.h:135-136), and the global-tier controls
+(ADD_GLOBAL_NODE, BARRIER_GLOBAL).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import struct
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+MAGIC = 0x47454F4D  # "GEOM"
+
+_PREHDR = struct.Struct("<IiBiI")  # magic, recver, flags, priority, meta_len
+_U32 = struct.Struct("<I")
+
+FLAG_GLOBAL = 0x1
+
+
+class Control(enum.IntEnum):
+    """Control verbs (reference: message.h:125-137)."""
+
+    EMPTY = 0
+    TERMINATE = 1
+    ADD_NODE = 2
+    ADD_GLOBAL_NODE = 3
+    BARRIER = 4
+    BARRIER_GLOBAL = 5
+    ACK = 6
+    HEARTBEAT = 7
+    # TSEngine matchmaking verbs (reference: message.h:135-136)
+    ASKPULL = 8
+    ASKPUSH = 9
+    REPLY = 10
+    AUTOPULLREPLY = 11
+
+
+class Role(enum.IntEnum):
+    SERVER = 0
+    WORKER = 1
+    SCHEDULER = 2
+
+
+@dataclasses.dataclass
+class Node:
+    """A registered node in one tier (reference: message.h:52-96)."""
+
+    role: int = Role.WORKER
+    id: int = -1
+    hostname: str = ""
+    port: int = 0
+    is_recovery: bool = False
+    customer_id: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "role": int(self.role),
+            "id": self.id,
+            "hostname": self.hostname,
+            "port": self.port,
+            "is_recovery": self.is_recovery,
+            "customer_id": self.customer_id,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Node":
+        return Node(
+            role=int(d.get("role", Role.WORKER)),
+            id=int(d.get("id", -1)),
+            hostname=d.get("hostname", ""),
+            port=int(d.get("port", 0)),
+            is_recovery=bool(d.get("is_recovery", False)),
+            customer_id=int(d.get("customer_id", 0)),
+        )
+
+
+@dataclasses.dataclass
+class Meta:
+    """Message metadata (reference: message.h:140-268)."""
+
+    # addressing / app routing
+    sender: int = -1
+    recver: int = -1
+    app_id: int = -1
+    customer_id: int = 0
+    timestamp: int = -1          # request id for response matching
+    is_global: bool = False      # which overlay the message belongs to
+
+    # request/response semantics
+    request: bool = False
+    push: bool = False
+    pull: bool = False
+    simple_app: bool = False
+    head: int = 0                # command id for simple_app messages
+    body: str = ""               # command payload (e.g. pickled optimizer)
+
+    # control
+    control_cmd: int = Control.EMPTY
+    nodes: List[Node] = dataclasses.field(default_factory=list)
+    barrier_group: int = 0
+    msg_sig: int = 0             # for ACK/resend matching
+
+    # data typing: one entry per data part (dtype string / shape list)
+    dtypes: List[str] = dataclasses.field(default_factory=list)
+    shapes: List[List[int]] = dataclasses.field(default_factory=list)
+
+    # scheduling
+    priority: int = 0
+    version: int = 0
+    key: int = -1                # principal key (P3/TSEngine bookkeeping)
+    iters: int = 0
+
+    # compression tag for this message's val parts ("", "fp16", "bsc", "2bit")
+    compr: str = ""
+
+    # DGT block fields (reference: message.h:237-253)
+    first_key: int = -1
+    seq: int = -1
+    seq_begin: int = -1
+    seq_end: int = -1
+    msg_type: int = 0
+    val_bytes: int = 0
+    total_bytes: int = 0
+    channel: int = 0
+    tos: int = 0
+
+    # TSEngine bookkeeping
+    num_merge: int = 1
+
+    # aux-array layout for KV payloads (bitmask over keys; see kv_app._pack_kv)
+    aux_mask: int = 0
+    aux_len: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if v == f.default and not isinstance(f.default, dataclasses._MISSING_TYPE):
+                continue  # omit defaults to keep frames small
+            if f.name == "nodes":
+                if v:
+                    d["nodes"] = [n.to_dict() for n in v]
+                continue
+            if f.name in ("dtypes", "shapes"):
+                if v:
+                    d[f.name] = v
+                continue
+            d[f.name] = v
+        return d
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Meta":
+        m = Meta()
+        for k, v in d.items():
+            if k == "nodes":
+                m.nodes = [Node.from_dict(n) for n in v]
+            elif hasattr(m, k):
+                setattr(m, k, v)
+        return m
+
+
+@dataclasses.dataclass
+class Message:
+    """Meta + zero or more binary data parts.
+
+    For KV traffic part 0 is the key array (int64) and subsequent parts are
+    value buffers / length arrays, mirroring ps-lite's keys/vals/lens triple
+    (reference: kv_app.h:39-77).
+    """
+
+    meta: Meta = dataclasses.field(default_factory=Meta)
+    data: List[bytes] = dataclasses.field(default_factory=list)
+
+    # -- framing ---------------------------------------------------------
+
+    def pack(self) -> bytes:
+        meta_b = json.dumps(self.meta.to_dict(), separators=(",", ":")).encode()
+        flags = FLAG_GLOBAL if self.meta.is_global else 0
+        out = [
+            _PREHDR.pack(MAGIC, self.meta.recver, flags, self.meta.priority, len(meta_b)),
+            meta_b,
+            _U32.pack(len(self.data)),
+        ]
+        for part in self.data:
+            mv = memoryview(part)
+            out.append(_U32.pack(len(mv)))
+            out.append(mv)
+        return b"".join(out)
+
+    @staticmethod
+    def unpack(buf: bytes) -> "Message":
+        magic, recver, flags, priority, meta_len = _PREHDR.unpack_from(buf, 0)
+        if magic != MAGIC:
+            raise ValueError(f"bad frame magic {magic:#x}")
+        off = _PREHDR.size
+        meta = Meta.from_dict(json.loads(buf[off:off + meta_len].decode()))
+        meta.recver = recver
+        meta.priority = priority
+        meta.is_global = bool(flags & FLAG_GLOBAL)
+        off += meta_len
+        (ndata,) = _U32.unpack_from(buf, off)
+        off += _U32.size
+        data: List[bytes] = []
+        for _ in range(ndata):
+            (n,) = _U32.unpack_from(buf, off)
+            off += _U32.size
+            data.append(bytes(buf[off:off + n]))
+            off += n
+        return Message(meta=meta, data=data)
+
+    # -- tensor helpers --------------------------------------------------
+
+    def add_array(self, arr: np.ndarray) -> None:
+        arr = np.ascontiguousarray(arr)
+        self.meta.dtypes.append(arr.dtype.str)
+        self.meta.shapes.append(list(arr.shape))
+        self.data.append(arr.tobytes())
+
+    def get_array(self, i: int) -> np.ndarray:
+        dt = np.dtype(self.meta.dtypes[i])
+        shape = tuple(self.meta.shapes[i])
+        return np.frombuffer(self.data[i], dtype=dt).reshape(shape)
+
+    def arrays(self) -> List[np.ndarray]:
+        return [self.get_array(i) for i in range(len(self.data))]
+
+    @property
+    def is_control(self) -> bool:
+        return self.meta.control_cmd != Control.EMPTY
+
+
+def read_frame(sock) -> Optional[bytes]:
+    """Read one complete frame from a socket-like object; None on EOF."""
+    hdr = _read_exact(sock, _PREHDR.size)
+    if hdr is None:
+        return None
+    magic, _recver, _flags, _prio, meta_len = _PREHDR.unpack(hdr)
+    if magic != MAGIC:
+        raise ValueError(f"bad frame magic {magic:#x}")
+    meta_b = _read_exact(sock, meta_len)
+    if meta_b is None:
+        return None
+    nd_b = _read_exact(sock, _U32.size)
+    if nd_b is None:
+        return None
+    (ndata,) = _U32.unpack(nd_b)
+    parts = [hdr, meta_b, nd_b]
+    for _ in range(ndata):
+        ln_b = _read_exact(sock, _U32.size)
+        if ln_b is None:
+            return None
+        (n,) = _U32.unpack(ln_b)
+        payload = _read_exact(sock, n)
+        if payload is None:
+            return None
+        parts.append(ln_b)
+        parts.append(payload)
+    return b"".join(parts)
+
+
+def _read_exact(sock, n: int) -> Optional[bytes]:
+    chunks = []
+    remaining = n
+    while remaining > 0:
+        try:
+            chunk = sock.recv(min(remaining, 1 << 20))
+        except (ConnectionResetError, OSError):
+            return None
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)

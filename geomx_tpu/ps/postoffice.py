@@ -1,0 +1,163 @@
+"""Per-tier node management: the Postoffice.
+
+Plays the role of ps-lite's dual-overlay ``Postoffice`` (reference:
+3rdparty/ps-lite/include/ps/internal/postoffice.h:18-234, src/postoffice.cc).
+The reference threads ``is_global`` flags through one singleton; we instead
+instantiate one Postoffice per tier — a server process participating in HiPS
+owns two (its intra-DC tier as a server, the inter-DC tier as a global
+worker or global server).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from geomx_tpu import config as cfg_mod
+from geomx_tpu.ps import base
+from geomx_tpu.ps.customer import Customer
+from geomx_tpu.ps.message import Control, Message, Role
+from geomx_tpu.ps.van import Van
+
+log = logging.getLogger("geomx.postoffice")
+
+
+class Postoffice:
+    def __init__(
+        self,
+        *,
+        my_role: int,
+        is_global: bool,
+        root_uri: str,
+        root_port: int,
+        num_workers: int,
+        num_servers: int,
+        cfg: Optional[cfg_mod.Config] = None,
+    ):
+        cfg = cfg or cfg_mod.load()
+        self.cfg = cfg
+        self.is_global = is_global
+        self.my_role = my_role
+        self.num_workers = num_workers
+        self.num_servers = num_servers
+        self.van = Van(
+            my_role=my_role,
+            is_global=is_global,
+            root_uri=root_uri,
+            root_port=root_port,
+            num_workers=num_workers,
+            num_servers=num_servers,
+            bind_host=cfg.node_host or "127.0.0.1",
+            drop_rate=cfg.drop_rate,
+            heartbeat_interval_s=cfg.heartbeat_interval_s,
+            heartbeat_timeout_s=cfg.heartbeat_timeout_s,
+            use_priority_send=cfg.enable_p3 and my_role == Role.WORKER,
+            verbose=cfg.verbose,
+        )
+        self.van.msg_handler = self._dispatch
+        self._customers: Dict[Tuple[int, int], Customer] = {}
+        self._customers_lock = threading.Lock()
+        self._started = False
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self, timeout: float = 60.0) -> None:
+        if self._started:
+            return
+        self.van.start(timeout)
+        self._started = True
+        log.debug(
+            "postoffice started: tier=%s role=%s id=%d",
+            "global" if self.is_global else "local",
+            Role(self.my_role).name,
+            self.van.my_id,
+        )
+
+    def finalize(self, do_barrier: bool = True) -> None:
+        if not self._started:
+            return
+        if do_barrier:
+            try:
+                self.barrier(base.ALL_GROUP, timeout=30.0)
+            except (TimeoutError, OSError):
+                log.warning("finalize barrier failed; stopping anyway")
+        with self._customers_lock:
+            for c in self._customers.values():
+                c.stop()
+        self.van.stop()
+        self._started = False
+
+    # -- identity --------------------------------------------------------
+
+    @property
+    def my_id(self) -> int:
+        return self.van.my_id
+
+    @property
+    def my_rank(self) -> int:
+        return base.id_to_rank(self.van.my_id)
+
+    @property
+    def is_worker(self) -> bool:
+        return self.my_role == Role.WORKER
+
+    @property
+    def is_server(self) -> bool:
+        return self.my_role == Role.SERVER
+
+    @property
+    def is_scheduler(self) -> bool:
+        return self.my_role == Role.SCHEDULER
+
+    def worker_ids(self) -> List[int]:
+        return [base.worker_rank_to_id(r) for r in range(self.num_workers)]
+
+    def server_ids(self) -> List[int]:
+        return [base.server_rank_to_id(r) for r in range(self.num_servers)]
+
+    # -- customers -------------------------------------------------------
+
+    def register_customer(self, customer: Customer) -> None:
+        key = (customer.app_id, customer.customer_id)
+        with self._customers_lock:
+            assert key not in self._customers, f"duplicate customer {key}"
+            self._customers[key] = customer
+
+    def deregister_customer(self, customer: Customer) -> None:
+        with self._customers_lock:
+            self._customers.pop((customer.app_id, customer.customer_id), None)
+
+    def _dispatch(self, msg: Message) -> None:
+        key = (msg.meta.app_id, msg.meta.customer_id)
+        with self._customers_lock:
+            cust = self._customers.get(key)
+        if cust is None:
+            # fall back to any customer of the app (responses to requests
+            # issued from a different customer_id thread)
+            with self._customers_lock:
+                for (app, _cid), c in self._customers.items():
+                    if app == msg.meta.app_id:
+                        cust = c
+                        break
+        if cust is None:
+            log.warning("no customer for app=%s cid=%s; dropping message", *key)
+            return
+        cust.accept(msg)
+
+    # -- barriers (reference: postoffice.h:167) --------------------------
+
+    def barrier(self, group: int, timeout: float = 300.0) -> None:
+        self.van.barrier(group, timeout)
+
+    # -- key ranges (reference: postoffice.h:76 GetServerKeyRanges) ------
+
+    def server_key_ranges(self, max_key: int = 1 << 58) -> List[Tuple[int, int]]:
+        n = self.num_servers
+        step = max_key // n
+        return [
+            (i * step, (i + 1) * step if i + 1 < n else max_key) for i in range(n)
+        ]
+
+    def num_dead_nodes(self) -> int:
+        return len(self.van.dead_nodes())

@@ -1,0 +1,557 @@
+"""The van: framed-TCP message router for one tier overlay.
+
+Plays the role of ps-lite's ``Van``/``ZMQVan`` (reference:
+3rdparty/ps-lite/src/van.cc:26-1497, src/zmq_van.h:41-516) for a single
+overlay; a process participating in both HiPS tiers runs two vans (the
+reference multiplexes both overlays through one Van with a second receiver
+thread, van.cc:557-671 — we use two instances for isolation).
+
+Responsibilities:
+- listener socket + accept/reader threads; outbound connections dialed
+  lazily per destination id;
+- scheduler-side rendezvous: collect ADD_NODE registrations, assign ranks
+  deterministically, broadcast the node table (reference: van.cc:41-234
+  ProcessAddNodeCommandAtScheduler);
+- counted group barriers (reference: van.cc:259-288);
+- heartbeats and dead-node tracking (reference: van.cc:1128-1140);
+- fault injection via PS_DROP_MSG (reference: van.cc:498-499, 871-877);
+- optional priority-ordered sending thread (P3 — reference: van.cc:548,851);
+- recovery: a node re-registering for a dead slot is handed the dead
+  node's id with ``is_recovery=True`` (reference: van.cc:176-193).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+import logging
+import random
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from geomx_tpu.ps import base
+from geomx_tpu.ps.message import Control, Message, Meta, Node, Role, read_frame
+
+log = logging.getLogger("geomx.van")
+
+
+class Van:
+    """One overlay's message router."""
+
+    def __init__(
+        self,
+        *,
+        my_role: int,
+        is_global: bool,
+        root_uri: str,
+        root_port: int,
+        num_workers: int,
+        num_servers: int,
+        bind_host: str = "127.0.0.1",
+        drop_rate: float = 0.0,
+        heartbeat_interval_s: float = 0.0,
+        heartbeat_timeout_s: float = 60.0,
+        use_priority_send: bool = False,
+        verbose: int = 0,
+    ):
+        self.my_role = my_role
+        self.is_global = is_global
+        self.root_uri = root_uri
+        self.root_port = root_port
+        self.num_workers = num_workers
+        self.num_servers = num_servers
+        self.bind_host = bind_host
+        self.drop_rate = drop_rate
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.use_priority_send = use_priority_send
+        self.verbose = verbose
+
+        self.my_id: int = -1
+        self.is_scheduler = my_role == Role.SCHEDULER
+        self.ready = threading.Event()
+        self.stopped = threading.Event()
+
+        # id -> (hostname, port); filled from the broadcast node table
+        self.node_table: Dict[int, Tuple[str, int]] = {}
+        self.node_roles: Dict[int, int] = {}
+
+        # outbound connections: id -> (socket, send_lock)
+        self._conns: Dict[int, Tuple[socket.socket, threading.Lock]] = {}
+        self._conn_lock = threading.Lock()
+
+        # scheduler rendezvous state
+        self._registrations: List[Node] = []
+        self._reg_lock = threading.Lock()
+        self._barrier_counts: Dict[int, int] = {}
+
+        # member-side barrier release
+        self._barrier_done: Dict[int, threading.Event] = {}
+        self._barrier_lock = threading.Lock()
+
+        # heartbeat bookkeeping (scheduler side)
+        self._heartbeats: Dict[int, float] = {}
+
+        # upward dispatch: set by Postoffice before start()
+        self.msg_handler: Optional[Callable[[Message], None]] = None
+        # called on the scheduler when the topology is (re)broadcast
+        self.on_node_update: Optional[Callable[[List[Node]], None]] = None
+
+        self._listener: Optional[socket.socket] = None
+        self._threads: List[threading.Thread] = []
+        self._send_queue: List[Tuple[int, int, Message]] = []
+        self._send_cv = threading.Condition()
+        self._send_seq = itertools.count()
+        self.send_bytes = 0
+        self.recv_bytes = 0
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self, timeout: float = 60.0) -> None:
+        self._bind()
+        self._spawn(self._accept_loop, "van-accept")
+        if self.use_priority_send:
+            self._spawn(self._priority_send_loop, "van-psend")
+        if self.is_scheduler:
+            self.my_id = base.SCHEDULER
+            self.node_table[base.SCHEDULER] = (self.bind_host, self.root_port)
+            self.node_roles[base.SCHEDULER] = Role.SCHEDULER
+            # scheduler is ready once every node has registered; barrier-less
+            # callers may proceed as soon as the table is broadcast
+        else:
+            self._register(timeout)
+        if not self.ready.wait(timeout):
+            raise TimeoutError(
+                f"van ({'global' if self.is_global else 'local'} tier, role "
+                f"{Role(self.my_role).name}) rendezvous timed out after {timeout}s"
+            )
+        if self.heartbeat_interval_s > 0 and not self.is_scheduler:
+            self._spawn(self._heartbeat_loop, "van-heartbeat")
+
+    def stop(self) -> None:
+        self.stopped.set()
+        with self._send_cv:
+            self._send_cv.notify_all()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._conn_lock:
+            for sock, _ in self._conns.values():
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+
+    def _bind(self) -> None:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if self.is_scheduler:
+            s.bind((self.bind_host, self.root_port))
+        else:
+            s.bind((self.bind_host, 0))
+        s.listen(128)
+        self._listener = s
+        self.my_port = s.getsockname()[1]
+
+    def _register(self, timeout: float) -> None:
+        """Send ADD_NODE to the scheduler (reference: van.cc:509-516)."""
+        node = Node(
+            role=self.my_role,
+            hostname=self.bind_host,
+            port=self.my_port,
+        )
+        msg = Message(
+            Meta(
+                recver=base.SCHEDULER,
+                control_cmd=Control.ADD_GLOBAL_NODE if self.is_global else Control.ADD_NODE,
+                nodes=[node],
+                is_global=self.is_global,
+            )
+        )
+        deadline = time.monotonic() + timeout
+        while not self.stopped.is_set():
+            try:
+                self._send_to_addr((self.root_uri, self.root_port), msg)
+                return
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+
+    # ------------------------------------------------------------------
+    # sending
+    # ------------------------------------------------------------------
+
+    def send(self, msg: Message) -> int:
+        """Send a message; group recvers fan out (reference: van.cc:835)."""
+        recver = msg.meta.recver
+        assert recver > 0, f"invalid recver {recver}"
+        msg.meta.sender = self.my_id
+        msg.meta.is_global = self.is_global
+        targets = (
+            base.expand_group(recver, self.num_workers, self.num_servers)
+            if base.is_group(recver)
+            else [recver]
+        )
+        total = 0
+        for t in targets:
+            if t == self.my_id and msg.is_control:
+                # loopback for barrier/self messages
+                self._process(self._reframe(msg, t))
+                continue
+            m = self._reframe(msg, t)
+            if self.use_priority_send and not m.is_control:
+                with self._send_cv:
+                    heapq.heappush(
+                        self._send_queue, (-m.meta.priority, next(self._send_seq), m)
+                    )
+                    self._send_cv.notify()
+            else:
+                total += self._send_one(t, m)
+        return total
+
+    @staticmethod
+    def _reframe(msg: Message, target: int) -> Message:
+        if msg.meta.recver == target:
+            return msg
+        meta = dataclasses.replace(msg.meta, recver=target)
+        return Message(meta=meta, data=msg.data)
+
+    def _priority_send_loop(self) -> None:
+        while not self.stopped.is_set():
+            with self._send_cv:
+                while not self._send_queue and not self.stopped.is_set():
+                    self._send_cv.wait(0.5)
+                if self.stopped.is_set():
+                    return
+                _, _, msg = heapq.heappop(self._send_queue)
+            try:
+                self._send_one(msg.meta.recver, msg)  # retries once internally
+            except OSError as e:
+                # TODO(resender): hand to the ACK/retransmit layer when it
+                # lands; until then surface loudly — a lost data message
+                # stalls the requester until its wait() timeout
+                log.error("priority send to %d failed permanently: %s",
+                          msg.meta.recver, e)
+
+    def _send_one(self, target: int, msg: Message) -> int:
+        buf = msg.pack()
+        for attempt in (0, 1):
+            conn = self._get_conn(target)
+            if conn is None:
+                raise OSError(f"no route to node {target}")
+            sock, lock = conn
+            try:
+                with lock:
+                    sock.sendall(buf)
+                self.send_bytes += len(buf)
+                return len(buf)
+            except OSError:
+                # evict the (possibly stale) cached connection and re-dial
+                # once — the peer may have restarted at a new address
+                self._evict_conn(target, sock)
+                if attempt == 1:
+                    raise
+        return 0
+
+    def _evict_conn(self, target: int, sock: Optional[socket.socket] = None) -> None:
+        with self._conn_lock:
+            cur = self._conns.get(target)
+            if cur is not None and (sock is None or cur[0] is sock):
+                self._conns.pop(target, None)
+                try:
+                    cur[0].close()
+                except OSError:
+                    pass
+
+    def _get_conn(self, target: int):
+        with self._conn_lock:
+            c = self._conns.get(target)
+        if c is not None:
+            return c
+        addr = self.node_table.get(target)
+        if addr is None:
+            return None
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.connect(addr)
+        pair = (sock, threading.Lock())
+        with self._conn_lock:
+            # lost the race? keep the existing one
+            if target in self._conns:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return self._conns[target]
+            self._conns[target] = pair
+        return pair
+
+    def _send_to_addr(self, addr: Tuple[str, int], msg: Message) -> None:
+        """One-shot registration send before the node table exists."""
+        msg.meta.sender = self.my_id
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.settimeout(10.0)
+        sock.connect(addr)
+        sock.sendall(msg.pack())
+        sock.close()
+
+    # ------------------------------------------------------------------
+    # receiving
+    # ------------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        assert self._listener is not None
+        while not self.stopped.is_set():
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._spawn(self._reader_loop, "van-read", conn)
+
+    def _reader_loop(self, conn: socket.socket) -> None:
+        while not self.stopped.is_set():
+            try:
+                frame = read_frame(conn)
+            except (ValueError, OSError):
+                break
+            if frame is None:
+                break
+            self.recv_bytes += len(frame)
+            try:
+                msg = Message.unpack(frame)
+                if (
+                    self.drop_rate > 0
+                    and not msg.is_control
+                    and random.random() < self.drop_rate
+                ):
+                    if self.verbose:
+                        log.info("PS_DROP_MSG: dropping frame from %d", msg.meta.sender)
+                    continue
+                self._process(msg)
+            except Exception:
+                # an exception here must not kill the reader thread — that
+                # would silently sever the connection for all future frames
+                log.exception("error processing inbound frame; connection kept")
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _process(self, msg: Message) -> None:
+        cmd = msg.meta.control_cmd
+        if cmd in (Control.ADD_NODE, Control.ADD_GLOBAL_NODE):
+            self._process_add_node(msg)
+        elif cmd in (Control.BARRIER, Control.BARRIER_GLOBAL):
+            self._process_barrier(msg)
+        elif cmd == Control.HEARTBEAT:
+            self._heartbeats[msg.meta.sender] = time.monotonic()
+        elif cmd == Control.TERMINATE:
+            self.stopped.set()
+        else:
+            handler = self.msg_handler
+            if handler is not None:
+                handler(msg)
+
+    # ------------------------------------------------------------------
+    # rendezvous (scheduler + member sides)
+    # ------------------------------------------------------------------
+
+    def _process_add_node(self, msg: Message) -> None:
+        if self.is_scheduler and msg.meta.request is False and msg.meta.sender == -1:
+            # a fresh registration from an unidentified node
+            self._scheduler_register(msg.meta.nodes[0])
+        elif not self.is_scheduler:
+            # the broadcast node table; find my slot by (host, port)
+            for n in msg.meta.nodes:
+                old = self.node_table.get(n.id)
+                if old is not None and old != (n.hostname, n.port):
+                    # peer recovered at a new address: drop the stale route
+                    self._evict_conn(n.id)
+                self.node_table[n.id] = (n.hostname, n.port)
+                self.node_roles[n.id] = n.role
+                if (
+                    n.hostname == self.bind_host
+                    and n.port == self.my_port
+                    and n.role == self.my_role
+                ):
+                    self.my_id = n.id
+                    self.is_recovery = n.is_recovery
+            if self.my_id != -1:
+                self.ready.set()
+
+    def _scheduler_register(self, node: Node) -> None:
+        with self._reg_lock:
+            expected = self.num_workers + self.num_servers
+            dead = self.dead_nodes()
+            if len(self._registrations) >= expected and dead:
+                # recovery path: hand the dead slot's id to the newcomer
+                # (reference: van.cc:176-193)
+                for i, old in enumerate(self._registrations):
+                    if old.id in dead and old.role == node.role:
+                        node.id = old.id
+                        node.is_recovery = True
+                        self._registrations[i] = node
+                        self._heartbeats.pop(old.id, None)
+                        break
+                else:
+                    log.warning("re-registration with no matching dead slot")
+                    return
+            else:
+                self._registrations.append(node)
+            if len(self._registrations) < expected:
+                return
+            # assign ranks deterministically: sort per role by (host, port) so
+            # the same physical topology gets the same ids across runs
+            key = lambda n: (n.hostname, n.port)  # noqa: E731
+            servers = sorted(
+                (n for n in self._registrations if n.role == Role.SERVER), key=key
+            )
+            workers = sorted(
+                (n for n in self._registrations if n.role == Role.WORKER), key=key
+            )
+            for rank, n in enumerate(servers):
+                if n.id == -1:
+                    n.id = base.server_rank_to_id(rank)
+            for rank, n in enumerate(workers):
+                if n.id == -1:
+                    n.id = base.worker_rank_to_id(rank)
+            all_nodes = servers + workers + [
+                Node(
+                    role=Role.SCHEDULER,
+                    id=base.SCHEDULER,
+                    hostname=self.bind_host,
+                    port=self.root_port,
+                )
+            ]
+            for n in all_nodes:
+                old = self.node_table.get(n.id)
+                if old is not None and old != (n.hostname, n.port):
+                    self._evict_conn(n.id)
+                self.node_table[n.id] = (n.hostname, n.port)
+                self.node_roles[n.id] = n.role
+                # a fresh registration counts as a liveness signal so
+                # dead-node detection starts from "alive", not "unknown"
+                self._heartbeats[n.id] = time.monotonic()
+            self.ready.set()
+        # broadcast the table (outside the lock; sends can block)
+        bcast = Message(
+            Meta(
+                control_cmd=Control.ADD_GLOBAL_NODE if self.is_global else Control.ADD_NODE,
+                nodes=all_nodes,
+                is_global=self.is_global,
+            )
+        )
+        for n in all_nodes:
+            if n.role == Role.SCHEDULER:
+                continue
+            m = Message(meta=dataclasses.replace(bcast.meta, recver=n.id), data=[])
+            try:
+                self._send_one(n.id, m)
+            except OSError as e:
+                log.warning("failed to send node table to %d: %s", n.id, e)
+        if self.on_node_update:
+            self.on_node_update(all_nodes)
+
+    # ------------------------------------------------------------------
+    # barriers (reference: van.cc:259-288)
+    # ------------------------------------------------------------------
+
+    def barrier(self, group: int, timeout: float = 300.0) -> None:
+        ev = threading.Event()
+        with self._barrier_lock:
+            self._barrier_done[group] = ev
+        msg = Message(
+            Meta(
+                recver=base.SCHEDULER,
+                control_cmd=Control.BARRIER_GLOBAL if self.is_global else Control.BARRIER,
+                barrier_group=group,
+                request=True,
+                is_global=self.is_global,
+            )
+        )
+        self.send(msg)
+        if not ev.wait(timeout):
+            raise TimeoutError(f"barrier on group {group} timed out")
+
+    def _process_barrier(self, msg: Message) -> None:
+        if msg.meta.request:
+            assert self.is_scheduler
+            group = msg.meta.barrier_group
+            with self._barrier_lock:
+                self._barrier_counts[group] = self._barrier_counts.get(group, 0) + 1
+                expected = len(
+                    base.expand_group(group, self.num_workers, self.num_servers)
+                )
+                done = self._barrier_counts[group] >= expected
+                if done:
+                    self._barrier_counts[group] = 0
+            if done:
+                resp = Message(
+                    Meta(
+                        recver=group,
+                        control_cmd=msg.meta.control_cmd,
+                        barrier_group=group,
+                        request=False,
+                        is_global=self.is_global,
+                    )
+                )
+                self.send(resp)
+        else:
+            with self._barrier_lock:
+                ev = self._barrier_done.get(msg.meta.barrier_group)
+            if ev is not None:
+                ev.set()
+
+    # ------------------------------------------------------------------
+    # heartbeats (reference: van.cc:1128-1140)
+    # ------------------------------------------------------------------
+
+    def _heartbeat_loop(self) -> None:
+        while not self.stopped.wait(self.heartbeat_interval_s):
+            try:
+                self.send(
+                    Message(
+                        Meta(
+                            recver=base.SCHEDULER,
+                            control_cmd=Control.HEARTBEAT,
+                            is_global=self.is_global,
+                        )
+                    )
+                )
+            except OSError:
+                pass
+
+    def dead_nodes(self) -> List[int]:
+        """Nodes whose heartbeat has lapsed (reference: postoffice.h:187).
+
+        Heartbeats flow member -> scheduler only (as in the reference), so
+        this is meaningful on the scheduler; elsewhere it returns [].
+        """
+        if self.heartbeat_interval_s <= 0 or not self.is_scheduler:
+            return []
+        now = time.monotonic()
+        dead = []
+        for nid in list(self.node_table):
+            if nid in (base.SCHEDULER, self.my_id):
+                continue
+            last = self._heartbeats.get(nid)
+            if last is not None and now - last > self.heartbeat_timeout_s:
+                dead.append(nid)
+        return dead
+
+    # ------------------------------------------------------------------
+
+    def _spawn(self, fn, name: str, *args) -> None:
+        t = threading.Thread(target=fn, args=args, name=name, daemon=True)
+        t.start()
+        self._threads.append(t)

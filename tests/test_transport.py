@@ -1,0 +1,220 @@
+"""Transport-layer tests: rendezvous, barriers, push/pull round-trips.
+
+Because each tier is an independent Postoffice instance (no process-global
+singletons, unlike the reference's ps::Postoffice), an entire scheduler +
+server + worker topology can run inside one test process on ephemeral ports.
+"""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from geomx_tpu.ps import base
+from geomx_tpu.ps.kv_app import KVPairs, KVServer, KVWorker
+from geomx_tpu.ps.message import Message, Meta, Node, Role
+from geomx_tpu.ps.postoffice import Postoffice
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def make_tier(num_workers=2, num_servers=1, is_global=False):
+    """Boot a full tier in-process; returns (scheduler, servers, workers)."""
+    port = free_port()
+    kw = dict(
+        is_global=is_global,
+        root_uri="127.0.0.1",
+        root_port=port,
+        num_workers=num_workers,
+        num_servers=num_servers,
+    )
+    sched = Postoffice(my_role=Role.SCHEDULER, **kw)
+    servers = [Postoffice(my_role=Role.SERVER, **kw) for _ in range(num_servers)]
+    workers = [Postoffice(my_role=Role.WORKER, **kw) for _ in range(num_workers)]
+    threads = []
+    sched_t = threading.Thread(target=sched.start, daemon=True)
+    sched_t.start()
+    for po in servers + workers:
+        t = threading.Thread(target=po.start, daemon=True)
+        t.start()
+        threads.append(t)
+    sched_t.join(20)
+    for t in threads:
+        t.join(20)
+    for po in [sched] + servers + workers:
+        assert po.van.ready.is_set(), "rendezvous failed"
+    return sched, servers, workers
+
+
+def shutdown(*pos):
+    for po in pos:
+        po.finalize(do_barrier=False)
+
+
+def test_message_roundtrip():
+    m = Message(
+        Meta(
+            sender=9,
+            recver=8,
+            app_id=0,
+            timestamp=42,
+            request=True,
+            push=True,
+            priority=-3,
+            is_global=True,
+            nodes=[Node(role=Role.WORKER, id=9, hostname="127.0.0.1", port=1234)],
+        )
+    )
+    m.add_array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    m.add_array(np.array([1, 2, 3], dtype=np.int64))
+    buf = m.pack()
+    m2 = Message.unpack(buf)
+    assert m2.meta.sender == 9 and m2.meta.recver == 8
+    assert m2.meta.timestamp == 42 and m2.meta.push and m2.meta.request
+    assert m2.meta.priority == -3 and m2.meta.is_global
+    assert m2.meta.nodes[0].port == 1234
+    np.testing.assert_array_equal(m2.get_array(0), m.get_array(0))
+    np.testing.assert_array_equal(m2.get_array(1), np.array([1, 2, 3]))
+
+
+def test_rendezvous_assigns_ids():
+    sched, servers, workers = make_tier(num_workers=2, num_servers=2)
+    try:
+        assert sched.my_id == base.SCHEDULER
+        assert sorted(s.my_id for s in servers) == [8, 10]
+        assert sorted(w.my_id for w in workers) == [9, 11]
+        # every node has the full table
+        for po in servers + workers:
+            assert set(po.van.node_table) == {1, 8, 9, 10, 11}
+    finally:
+        shutdown(sched, *servers, *workers)
+
+
+def test_barrier_releases_all_members():
+    sched, servers, workers = make_tier(num_workers=2, num_servers=1)
+    try:
+        done = []
+
+        def do_barrier(po):
+            po.barrier(base.WORKER_SERVER_GROUP, timeout=20)
+            done.append(po.my_id)
+
+        ts = [
+            threading.Thread(target=do_barrier, args=(po,), daemon=True)
+            for po in servers + workers
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(20)
+        assert sorted(done) == sorted([8, 9, 11])
+    finally:
+        shutdown(sched, *servers, *workers)
+
+
+def test_push_pull_roundtrip():
+    sched, servers, workers = make_tier(num_workers=2, num_servers=1)
+    store = {}
+    try:
+        server = KVServer(servers[0])
+
+        def handle(req, kvs, srv):
+            if req.push:
+                for k, v in zip(kvs.keys, kvs.vals):
+                    store[k] = store.get(k, 0) + v
+                srv.response(req)
+            elif req.pull:
+                out = KVPairs(
+                    keys=kvs.keys, vals=[store[k] for k in kvs.keys]
+                )
+                srv.response(req, out)
+
+        server.set_request_handle(handle)
+
+        w0 = KVWorker(workers[0])
+        w1 = KVWorker(workers[1])
+        v = np.ones((4, 3), dtype=np.float32)
+        ts0 = w0.push(KVPairs(keys=[7], vals=[v]), server_rank=0)
+        ts1 = w1.push(KVPairs(keys=[7], vals=[2 * v]), server_rank=0)
+        w0.wait(ts0, 10)
+        w1.wait(ts1, 10)
+
+        ts = w0.pull([7], server_rank=0)
+        w0.wait(ts, 10)
+        (resp,) = w0.take_response(ts)
+        np.testing.assert_allclose(resp.vals[0], 3 * v)
+    finally:
+        shutdown(sched, *servers, *workers)
+
+
+def test_simple_app_command():
+    sched, servers, workers = make_tier(num_workers=1, num_servers=1)
+    got = {}
+    try:
+        server = KVServer(servers[0])
+
+        def handle(req, kvs, srv):
+            if req.simple_app:
+                got["head"] = req.head
+                got["body"] = req.body
+                srv.response(req)
+
+        server.set_request_handle(handle)
+        w = KVWorker(workers[0])
+        ts = w.request(head=5, body="sync_mode", recver=base.server_rank_to_id(0))
+        w.wait(ts, 10)
+        assert got == {"head": 5, "body": "sync_mode"}
+    finally:
+        shutdown(sched, *servers, *workers)
+
+
+def test_two_tiers_coexist():
+    """A process can be a local-tier server and a global-tier worker at once."""
+    sched_l, servers_l, workers_l = make_tier(num_workers=1, num_servers=1)
+    sched_g, servers_g, workers_g = make_tier(
+        num_workers=1, num_servers=1, is_global=True
+    )
+    try:
+        # the "intra-DC server" owns both: its local KVServer and a global KVWorker
+        local_server = KVServer(servers_l[0])
+        global_store = {}
+        gserver = KVServer(servers_g[0])
+
+        def ghandle(req, kvs, srv):
+            if req.push:
+                for k, v in zip(kvs.keys, kvs.vals):
+                    global_store[k] = v
+                srv.response(req)
+
+        gserver.set_request_handle(ghandle)
+        gworker = KVWorker(workers_g[0])
+
+        def lhandle(req, kvs, srv):
+            if req.push:
+                # forward aggregated grad up to the global tier
+                ts = gworker.push(kvs, server_rank=0)
+                gworker.wait(ts, 10)
+                srv.response(req)
+
+        local_server.set_request_handle(lhandle)
+
+        w = KVWorker(workers_l[0])
+        v = np.full((2, 2), 5.0, dtype=np.float32)
+        ts = w.push(KVPairs(keys=[3], vals=[v]), server_rank=0)
+        w.wait(ts, 10)
+        np.testing.assert_allclose(global_store[3], v)
+    finally:
+        shutdown(sched_l, *servers_l, *workers_l, sched_g, *servers_g, *workers_g)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))
