@@ -1,4 +1,31 @@
-"""geomx_tpu.kvstore — placeholder (real implementation landing next)."""
+"""geomx_tpu.kvstore — the KVStore factory (mirrors mx.kv).
 
-def create(name="local"):
-    raise NotImplementedError("kvstore under construction")
+Reference: src/kvstore/kvstore.cc:41-82 KVStore::Create and
+python/mxnet/kvstore.py:663 create. Accepted type strings:
+
+- "local" / "device"            — single-process store
+- "dist" / "dist_sync" / "dist_sync_device" / "dist_sync_tpu"
+                                — distributed, FSA (both tiers synchronous)
+- "dist_async"                  — distributed, MixedSync (async global tier)
+
+The "_tpu" suffix is accepted for parity with the driver's target config
+string; device-level aggregation on TPU happens inside jitted train steps
+(see geomx_tpu.parallel), so all dist variants share one implementation.
+"""
+
+from __future__ import annotations
+
+from geomx_tpu.kvstore.base import Command, KVStore  # noqa: F401
+from geomx_tpu.kvstore.local import KVStoreLocal  # noqa: F401
+
+
+def create(name: str = "local") -> KVStore:
+    tname = name.lower()
+    if "dist" in tname:
+        from geomx_tpu.kvstore.dist import KVStoreDist
+
+        sync_global = "_sync" in tname or tname == "dist"
+        if "_async" in tname:
+            sync_global = False
+        return KVStoreDist(sync_global=sync_global)
+    return KVStoreLocal()

@@ -1,0 +1,120 @@
+"""Abstract KVStore interface + server command constants.
+
+Mirrors the reference's user-facing KVStore surface (reference:
+include/mxnet/kvstore.h:59-480 and python/mxnet/kvstore.py:99-705) so code
+written against GeoMX's ``mx.kv`` moves over mechanically: ``init``,
+``push(..., priority=)``, ``pull``, ``set_optimizer``,
+``set_gradient_compression``, ``barrier``, ``rank`` / ``num_workers`` /
+``num_all_workers`` / ``is_master_worker``.
+
+Values are array-likes (numpy or jax); push accepts a single array or a
+list of per-device arrays which are summed (the reference's Comm reduce,
+src/kvstore/comm.h:104 — on TPU, prefer doing this inside the jitted step
+via psum and pushing the already-reduced array).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+
+# Server command channel (reference: src/kvstore/kvstore_dist_server.h:46-52).
+class Command:
+    CONTROLLER = 1                # body = pickled optimizer
+    STOP_SERVER = 2
+    SYNC_MODE = 3
+    SYNC_GLOBAL_MODE = 4
+    SET_GRADIENT_COMPRESSION = 5
+    SET_PROFILER_PARAMS = 6
+    SET_MULTI_PRECISION = 7
+    GLOBAL_BARRIER = 8            # cross-party worker barrier (via servers)
+
+
+# Data-plane cmd values carried in push meta.head.
+DATA_DEFAULT = 0
+DATA_INIT = 1                     # initialization push (kv.init), never a gradient
+
+
+ArrayLike = Any  # numpy / jax arrays
+
+
+def _sum_values(value: Union[ArrayLike, Sequence[ArrayLike]]) -> np.ndarray:
+    """Reduce a per-device value list to one host array (Comm::Reduce)."""
+    if isinstance(value, (list, tuple)):
+        out = np.asarray(value[0])
+        for v in value[1:]:
+            out = out + np.asarray(v)
+        return out
+    return np.asarray(value)
+
+
+class KVStore:
+    """Abstract key-value store (reference: include/mxnet/kvstore.h:59)."""
+
+    def __init__(self):
+        self._compression_params: Optional[Dict] = None
+
+    # -- identity --------------------------------------------------------
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+    @property
+    def num_all_workers(self) -> int:
+        """Total trainers across every party (kvstore.py:541)."""
+        return self.num_workers
+
+    @property
+    def is_master_worker(self) -> bool:
+        """True on the central party's master worker (kvstore.py:554)."""
+        return False
+
+    @property
+    def type(self) -> str:
+        return "base"
+
+    # -- data plane ------------------------------------------------------
+
+    def init(self, key: Union[int, Sequence[int]], value) -> None:
+        raise NotImplementedError
+
+    def push(self, key, value, priority: int = 0) -> None:
+        raise NotImplementedError
+
+    def pull(self, key, out=None, priority: int = 0):
+        raise NotImplementedError
+
+    def wait(self, keys=None) -> None:
+        """Block until outstanding ops on ``keys`` (or all) complete."""
+
+    # -- control plane ---------------------------------------------------
+
+    def set_optimizer(self, optimizer) -> None:
+        raise NotImplementedError
+
+    def set_updater(self, updater) -> None:
+        raise NotImplementedError
+
+    def set_gradient_compression(self, compression_params: Dict) -> None:
+        self._compression_params = dict(compression_params)
+
+    def barrier(self, is_global: bool = False) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    # -- iteration helpers ----------------------------------------------
+
+    @staticmethod
+    def _as_key_list(key) -> List[int]:
+        if isinstance(key, (list, tuple)):
+            return [int(k) for k in key]
+        return [int(key)]

@@ -1,0 +1,327 @@
+"""KVStoreDist — the worker-side distributed store.
+
+Re-implements the reference's worker side (reference:
+src/kvstore/kvstore_dist.h:50-1002) without the MXNet engine:
+
+- key -> server sharding via the shared deterministic heuristic
+  (EncodeDefaultKey, kvstore_dist.h:725-816 -> geomx_tpu.kvstore.sharding);
+- async push/pull with the crucial ordering invariant the reference gets
+  from engine var-deps on comm_buf_: a pull for key K is not SENT until
+  K's outstanding push has been ACKED by the server (the server defers
+  push acks until fresh params are in its store, so pull responses are
+  always fresh — see kvstore.server docstring);
+- ``priority`` propagates into message meta; with ENABLE_P3 the van sends
+  data messages through a priority queue (reference: van.cc:548,851) and
+  pushes are sliced at bigarray granularity so later layers' small slices
+  can overtake earlier layers' bulk (reference: P3_EncodeDefaultKey,
+  kvstore_dist.h:768-805);
+- control commands: optimizer shipping (master worker -> global server,
+  pickled), sync modes, gradient compression, profiler, stop
+  (reference: kvstore_dist.h:180-235, kvstore.cc:56-63).
+
+TPU stance: this class carries HOST-side traffic only. Device-level
+gradient aggregation (the reference's comm_->Reduce over local GPUs,
+kvstore_dist.h:478) belongs inside the jitted train step as a psum over
+the ICI mesh — push the already-reduced host array, or pass a list of
+per-device arrays to ``push`` and they are summed on host as a fallback.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from geomx_tpu import config as cfg_mod
+from geomx_tpu.kvstore import sharding
+from geomx_tpu.kvstore.base import Command, DATA_INIT, KVStore, _sum_values
+from geomx_tpu.ps import base as psbase
+from geomx_tpu.ps.kv_app import KVPairs, KVWorker
+from geomx_tpu.ps.message import Role
+from geomx_tpu.ps.postoffice import Postoffice
+
+log = logging.getLogger("geomx.dist")
+
+
+class _KeyInfo:
+    __slots__ = ("total", "shape", "dtype", "shards")
+
+    def __init__(self, total, shape, dtype, shards):
+        self.total = total
+        self.shape = shape
+        self.dtype = dtype
+        self.shards = shards
+
+
+class KVStoreDist(KVStore):
+    def __init__(self, sync_global: bool = True,
+                 cfg: Optional[cfg_mod.Config] = None):
+        super().__init__()
+        self.cfg = cfg or cfg_mod.load()
+        c = self.cfg
+        self._sync_global = sync_global
+        self.po = Postoffice(
+            my_role=Role.WORKER, is_global=False,
+            root_uri=c.ps_root_uri, root_port=c.ps_root_port,
+            num_workers=c.num_workers, num_servers=c.num_servers, cfg=c,
+        )
+        self.po.start()
+        self.kvw = KVWorker(self.po)
+
+        self._key_info: Dict[int, _KeyInfo] = {}
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # per-key: outstanding push shard-acks, and deferred pulls waiting
+        # on them (the engine-ordering equivalent)
+        self._push_acks_left: Dict[int, int] = {}
+        self._deferred: Dict[int, List] = {}
+        self._outstanding = 0
+
+        # startup barrier (reference: kvstore_dist.h:64), then the
+        # creation-time command protocol (reference: kvstore.cc:56-63)
+        self.po.barrier(psbase.ALL_GROUP, timeout=600.0)
+        if self.rank == 0:
+            self._send_command(Command.SYNC_MODE, "1")
+        if self.is_master_worker:
+            self._send_command(Command.SYNC_GLOBAL_MODE,
+                               "1" if sync_global else "0")
+        self._closed = False
+        import atexit
+
+        atexit.register(self.close)
+
+    # -- identity --------------------------------------------------------
+
+    @property
+    def type(self) -> str:
+        return "dist_sync" if self._sync_global else "dist_async"
+
+    @property
+    def rank(self) -> int:
+        return self.po.my_rank
+
+    @property
+    def num_workers(self) -> int:
+        return self.po.num_workers
+
+    @property
+    def num_all_workers(self) -> int:
+        return self.cfg.num_all_workers
+
+    @property
+    def is_master_worker(self) -> bool:
+        return self.cfg.is_master_worker
+
+    def get_num_dead_node(self) -> int:
+        return self.po.num_dead_nodes()
+
+    # -- helpers ---------------------------------------------------------
+
+    def _shards(self, key: int, total: int) -> List[sharding.Shard]:
+        return sharding.assign(key, total, self.po.num_servers,
+                               self.cfg.bigarray_bound)
+
+    def _info(self, key: int, value: Optional[np.ndarray] = None) -> _KeyInfo:
+        if key not in self._key_info:
+            assert value is not None, f"key {key} used before init"
+            v = np.asarray(value)
+            self._key_info[key] = _KeyInfo(
+                v.size, v.shape, v.dtype, self._shards(key, v.size))
+        return self._key_info[key]
+
+    def _track(self, n: int = 1) -> None:
+        with self._cv:
+            self._outstanding += n
+
+    def _untrack(self) -> None:
+        with self._cv:
+            self._outstanding -= 1
+            if self._outstanding <= 0:
+                self._cv.notify_all()
+
+    # -- data plane ------------------------------------------------------
+
+    def init(self, key, value) -> None:
+        """Rank-0 of each party pushes initial values; everyone barriers
+        (reference: kvstore_dist.h:262-299 InitImpl)."""
+        keys = self._as_key_list(key)
+        values = value if isinstance(value, (list, tuple)) and len(keys) > 1 \
+            else [value]
+        for k, v in zip(keys, values):
+            info = self._info(k, np.asarray(v))
+            if self.rank != 0:
+                continue
+            flat = np.ascontiguousarray(np.asarray(v)).ravel()
+            for sh in info.shards:
+                kvs = KVPairs(keys=[k],
+                              vals=[flat[sh.offset:sh.offset + sh.length]],
+                              offsets=[sh.offset], totals=[sh.total],
+                              lens=[sh.length])
+                ts = self.kvw.push(kvs, sh.server_rank, cmd=DATA_INIT)
+                self.kvw.wait(ts, 120.0)
+        self.barrier()
+
+    def push(self, key, value, priority: int = 0) -> None:
+        keys = self._as_key_list(key)
+        values = value if isinstance(value, (list, tuple)) and len(keys) > 1 \
+            else [value]
+        for k, v in zip(keys, values):
+            merged = _sum_values(v)
+            info = self._info(k, merged)
+            flat = np.ascontiguousarray(merged).ravel()
+            with self._lock:
+                self._push_acks_left[k] = (
+                    self._push_acks_left.get(k, 0) + len(info.shards))
+            self._track(len(info.shards))
+            for sh in info.shards:
+                kvs = KVPairs(keys=[k],
+                              vals=[flat[sh.offset:sh.offset + sh.length]],
+                              offsets=[sh.offset], totals=[sh.total],
+                              lens=[sh.length])
+                self.kvw.push(kvs, sh.server_rank, priority=priority,
+                              cb=lambda _ts, kk=k: self._on_push_ack(kk))
+
+    def _on_push_ack(self, key: int) -> None:
+        ready = []
+        with self._lock:
+            self._push_acks_left[key] -= 1
+            if self._push_acks_left[key] == 0 and key in self._deferred:
+                ready = self._deferred.pop(key)
+        self._untrack()
+        for fn in ready:
+            fn()
+
+    def pull(self, key, out=None, priority: int = 0):
+        """Async pull into ``out`` (ordered after this key's push acks);
+        blocking when ``out`` is None. Use wait()/waitall to join."""
+        keys = self._as_key_list(key)
+        outs = out if isinstance(out, (list, tuple)) and len(keys) > 1 \
+            else [out] * len(keys)
+        results = []
+        for k, o in zip(keys, outs):
+            results.append(self._pull_one(k, o, priority))
+        if out is None:
+            return results[0] if len(results) == 1 else results
+        return None
+
+    def _pull_one(self, key: int, out, priority: int):
+        info = self._key_info.get(key)
+        assert info is not None, f"pull of key {key} before init"
+        if out is not None and not (isinstance(out, np.ndarray)
+                                    and out.flags.writeable):
+            raise TypeError(
+                "pull(out=...) requires a writable numpy ndarray; for jax "
+                "arrays use the blocking return form: x = kv.pull(key)")
+        done = threading.Event()
+        buf = np.zeros(info.total, dtype=np.float32)
+        remaining = [len(info.shards)]
+        self._track()
+
+        def issue():
+            for sh in info.shards:
+                self.kvw.pull(
+                    [key], sh.server_rank, offsets=[sh.offset],
+                    totals=[sh.total], lens=[sh.length], priority=priority,
+                    cb=lambda ts, s=sh: on_data(ts, s))
+
+        def on_data(ts: int, sh: sharding.Shard):
+            resps = self.kvw.take_response(ts)
+            for kvs in resps:
+                for i, _k in enumerate(kvs.keys):
+                    data = np.asarray(kvs.vals[i]).ravel().astype(np.float32)
+                    r_off = kvs.offset_of(i)
+                    n = min(data.size, info.total - r_off)
+                    buf[r_off:r_off + n] = data[:n]
+            with self._lock:
+                remaining[0] -= 1
+                last = remaining[0] == 0
+            if last:
+                if out is not None:
+                    # out must be a writable numpy ndarray (views are fine;
+                    # jax arrays are immutable — use the return form instead)
+                    np.copyto(out, buf.reshape(info.shape)
+                              .astype(info.dtype, copy=False))
+                done.set()
+                self._untrack()
+
+        with self._lock:
+            if self._push_acks_left.get(key, 0) > 0:
+                # defer until this key's push round is acked (fresh params)
+                self._deferred.setdefault(key, []).append(issue)
+                deferred = True
+            else:
+                deferred = False
+        if not deferred:
+            issue()
+        if out is None:
+            if not done.wait(300.0):
+                raise TimeoutError(f"pull of key {key} timed out")
+            return buf.reshape(info.shape).astype(info.dtype, copy=False)
+        return None
+
+    def wait(self, keys=None, timeout: float = 300.0) -> None:
+        """Block until all outstanding pushes/pulls complete (the
+        reference's mx.nd.waitall() moment)."""
+        with self._cv:
+            if not self._cv.wait_for(lambda: self._outstanding <= 0, timeout):
+                raise TimeoutError(
+                    f"wait: {self._outstanding} ops still outstanding")
+
+    waitall = wait
+
+    # -- control plane ---------------------------------------------------
+
+    def set_optimizer(self, optimizer) -> None:
+        """Ship the optimizer to the server tier that applies updates:
+        the master worker in HiPS topologies (reference: kvstore.py:452 +
+        kvstore_dist_server.h kController), rank 0 in single-tier PS."""
+        if self.cfg.has_global_tier or self.cfg.is_master_worker:
+            assert self.is_master_worker, \
+                "set_optimizer must run on the master worker in HiPS mode"
+        else:
+            assert self.rank == 0, "set_optimizer must run on rank 0"
+        body = pickle.dumps(optimizer).hex()
+        self._send_command(Command.CONTROLLER, body)
+
+    def set_gradient_compression(self, compression_params: Dict) -> None:
+        super().set_gradient_compression(compression_params)
+        if self.is_master_worker:
+            import json
+            self._send_command(Command.SET_GRADIENT_COMPRESSION,
+                               json.dumps(self._compression_params))
+
+    def _send_command(self, head: int, body: str) -> None:
+        ts = self.kvw.request(head, body, psbase.SERVER_GROUP)
+        self.kvw.wait(ts, 120.0)
+
+    def barrier(self, is_global: bool = False) -> None:
+        if is_global:
+            # all-party barrier relayed through the servers: every worker of
+            # every party must call this (reference: Barrier(is_global),
+            # kvstore_dist.h:208-211)
+            self._send_command(Command.GLOBAL_BARRIER, "")
+        else:
+            self.po.barrier(psbase.WORKER_GROUP)
+
+    def close(self) -> None:
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
+        try:
+            self.wait(timeout=30.0)
+        except TimeoutError:
+            pass
+        # the master worker must NOT stop its local server (= the global
+        # server); party rank-0 workers do (reference: kvstore_dist.h:76-82)
+        if self.rank == 0 and not self.is_master_worker:
+            try:
+                self._send_command(Command.STOP_SERVER, "")
+            except (TimeoutError, OSError):
+                pass
+        self.po.finalize(do_barrier=True)
+
+    def __del__(self):
+        pass  # explicit close() required; avoid surprises at gc time

@@ -1,0 +1,64 @@
+"""Single-process KVStore ("local" / "device").
+
+Plays the role of the reference's KVStoreLocal (reference:
+src/kvstore/kvstore_local.h): an in-process store with aggregate-on-push
+and an optional updater. On TPU the heavy path — multi-device gradient
+aggregation — should happen inside the jitted train step via ``psum``
+(see geomx_tpu.parallel); this class is the host-side store used for
+single-host workflows and as the shared aggregation logic for the dist
+worker's local device reduction.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from geomx_tpu.kvstore.base import KVStore, _sum_values
+
+
+class KVStoreLocal(KVStore):
+    def __init__(self):
+        super().__init__()
+        self._store: Dict[int, np.ndarray] = {}
+        self._updater = None
+
+    @property
+    def type(self) -> str:
+        return "local"
+
+    def init(self, key, value) -> None:
+        keys = self._as_key_list(key)
+        values = value if isinstance(value, (list, tuple)) and len(keys) > 1 else [value]
+        assert len(keys) == len(values)
+        for k, v in zip(keys, values):
+            assert k not in self._store, f"duplicate init of key {k}"
+            self._store[k] = np.array(np.asarray(v), dtype=None, copy=True)
+
+    def push(self, key, value, priority: int = 0) -> None:
+        keys = self._as_key_list(key)
+        values = value if isinstance(value, (list, tuple)) and len(keys) > 1 else [value]
+        for k, v in zip(keys, values):
+            merged = _sum_values(v)
+            if self._updater is not None:
+                self._store[k] = np.asarray(self._updater(k, merged, self._store[k]))
+            else:
+                # no updater: aggregate into the stored value (reference
+                # local-store semantics: push overwrites with the reduction)
+                self._store[k] = merged
+
+    def pull(self, key, out=None, priority: int = 0):
+        keys = self._as_key_list(key)
+        results = [self._store[k] for k in keys]
+        if out is not None:
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for o, r in zip(outs, results):
+                np.copyto(np.asarray(o), r)
+        return results[0] if len(results) == 1 else results
+
+    def set_updater(self, updater) -> None:
+        self._updater = updater
+
+    def set_optimizer(self, optimizer) -> None:
+        self._updater = optimizer

@@ -1,0 +1,708 @@
+"""KVStoreDistServer — the HiPS two-tier aggregation state machine.
+
+A ground-up re-implementation of the reference's server (reference:
+src/kvstore/kvstore_dist_server.h:169-2091) with the same observable
+protocol, re-designed for host-side asynchrony without the MXNet engine:
+
+- one process, two Postoffice overlays: an intra-DC ("local") tier where
+  this process is a server, and the inter-DC ("global") tier where it is
+  either a global worker (ordinary party server) or a global server
+  (central party; reference kvstore_dist.h:237-258 RunServer);
+- per-(key, shard-offset) states guarded by one lock; all protocol
+  transitions are callback-driven (no spin-waits, unlike the reference's
+  DataHandlePullDefault sleep-loop at kvstore_dist_server.h:1736-1739);
+- the synchronization backbone mirrors the reference exactly: worker push
+  acks are DEFERRED until the round's fresh parameters are in the store
+  (kvstore_dist_server.h:1146-1167), and workers do not issue a pull for a
+  key until its push ack arrived (the engine-var ordering the reference
+  gets from comm_buf_ read/write deps), so a pull always observes fresh
+  parameters;
+- init-on-first-push, with a pull-back from the global tier that gates all
+  early pulls (kvstore_dist_server.h:1241-1274);
+- HFA milestone-delta logic (kvstore_dist_server.h:988-998, 1327-1346);
+- MixedSync: the global tier applies the updater per arriving push with no
+  global barrier (DataHandleAsyncDefault, kvstore_dist_server.h:1532);
+- the optimizer runs ONLY on global servers (ApplyUpdates,
+  kvstore_dist_server.h:512), shipped from the master worker as a pickle
+  over the command channel (CommandType kController);
+- WAN compression (FP16 / BSC / MPQ) applies on the inter-DC hop only:
+  party servers compress forwarded aggregates and request compressed pulls;
+  the LAN tier stays uncompressed — matching the reference's placement.
+
+Generalization over the reference: a global server stores its CANONICAL
+RANGES of each key (from the deterministic sharding over the full key
+size) and accepts any (offset, length) sub-slice pushes against them,
+counting round completion in contributed elements — so parties with
+different local-server counts interoperate (the reference requires
+aligned wire-key ranges and supports only matching layouts).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import pickle
+import sys
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from geomx_tpu import config as cfg_mod
+from geomx_tpu.compression import make_compressor
+from geomx_tpu.kvstore import sharding
+from geomx_tpu.kvstore.base import Command, DATA_INIT
+from geomx_tpu.ps import base as psbase
+from geomx_tpu.ps.kv_app import KVPairs, KVServer, KVWorker, ReqMeta
+from geomx_tpu.ps.message import Message, Meta, Role
+from geomx_tpu.ps.postoffice import Postoffice
+
+log = logging.getLogger("geomx.server")
+
+Action = Callable[[], None]
+
+
+class _SysModulesUnpickler(pickle.Unpickler):
+    """Unpickler that never triggers ``__import__`` for loaded modules.
+
+    Server processes block INSIDE ``import geomx_tpu`` (reference-parity
+    bootstrap, see kvstore_server.py), so the parent package is mid-import
+    while handler threads run. A plain pickle.loads of the shipped
+    optimizer would ``__import__("geomx_tpu.optimizer")``, which waits on
+    the parent package's import lock -> deadlock. All needed submodules
+    are fully initialized in sys.modules by then; resolve from there.
+    """
+
+    def find_class(self, module, name):
+        mod = sys.modules.get(module)
+        if mod is not None:
+            return getattr(mod, name)
+        return super().find_class(module, name)
+
+
+def _safe_unpickle(data: bytes):
+    return _SysModulesUnpickler(io.BytesIO(data)).load()
+
+
+class _KeyState:
+    """Per-(key, shard-offset) protocol state (UpdateBuf + store_ entry)."""
+
+    __slots__ = (
+        "stored", "milestone", "merged", "push_reqs", "deferred_acks",
+        "pending_pulls", "initialized", "rounds", "offset", "length",
+        "total", "dtype", "elems_received", "init_elems", "fwd_parts",
+        "fwd_expected", "fwd_acks_left", "version", "pre_init_pushes",
+    )
+
+    def __init__(self, offset: int):
+        self.stored: Optional[np.ndarray] = None
+        self.milestone: Optional[np.ndarray] = None
+        self.merged: Optional[np.ndarray] = None
+        self.push_reqs: List[Tuple[ReqMeta, KVServer]] = []
+        self.deferred_acks: List[Tuple[ReqMeta, KVServer]] = []
+        self.pending_pulls: List[Tuple[ReqMeta, KVServer, int, int]] = []
+        self.initialized = False
+        self.rounds = 0
+        self.offset = offset
+        self.length = 0
+        self.total = 0
+        self.dtype = np.dtype(np.float32)
+        self.elems_received = 0
+        self.init_elems = 0
+        self.fwd_parts: Dict[int, np.ndarray] = {}
+        self.fwd_expected = 0
+        self.fwd_acks_left = 0
+        self.version = 0
+        # gradient pushes that raced ahead of initialization (replayed)
+        self.pre_init_pushes: List = []
+
+
+class KVStoreDistServer:
+    """Runs in every DMLC_ROLE=server process (global server included)."""
+
+    def __init__(self, cfg: Optional[cfg_mod.Config] = None):
+        self.cfg = cfg or cfg_mod.load()
+        c = self.cfg
+        self.is_global_server = c.is_global_server
+        # party servers forward to the global tier; the global server IS it
+        self.has_global_tier = c.has_global_tier and not self.is_global_server
+
+        self.po_local = Postoffice(
+            my_role=Role.SERVER, is_global=False,
+            root_uri=c.ps_root_uri, root_port=c.ps_root_port,
+            num_workers=c.num_workers, num_servers=c.num_servers, cfg=c,
+        )
+        self.po_global: Optional[Postoffice] = None
+        if c.has_global_tier:
+            self.po_global = Postoffice(
+                my_role=Role.SERVER if self.is_global_server else Role.WORKER,
+                is_global=True,
+                root_uri=c.ps_global_root_uri, root_port=c.ps_global_root_port,
+                num_workers=c.num_global_workers, num_servers=c.num_global_servers,
+                cfg=c,
+            )
+
+        self._lock = threading.RLock()
+        self._states: Dict[Tuple[int, int], _KeyState] = {}
+        self._key_total: Dict[int, int] = {}
+        self.sync_mode = True
+        # False by default (reference: kvstore_dist_server.h:2019); set by the
+        # master worker's kSyncGlobalMode command for "dist_sync" only —
+        # "dist_async" leaves it unset, which IS MixedSync
+        self.sync_global_mode = False
+        self._stops_received = 0
+        self.updater = None            # optimizer; applied on the global store
+        self.gc = make_compressor(None)
+        self.use_hfa = c.use_hfa
+        self.period_k2 = max(c.hfa_k2, 1)
+        self._stop = threading.Event()
+        self._stop_forwarded = False
+        # requests can arrive on the local tier while the global tier is
+        # still starting (the local startup barrier releases workers first);
+        # handlers block on this gate until start() completes
+        self._ready = threading.Event()
+
+        self.server_local: Optional[KVServer] = None
+        self.server_global: Optional[KVServer] = None
+        self.worker_global: Optional[KVWorker] = None
+
+    # ------------------------------------------------------------------
+    # lifecycle (reference: kvstore_dist.h:237-258 RunServer)
+    # ------------------------------------------------------------------
+
+    def start(self, timeout: float = 120.0) -> None:
+        self.po_local.start(timeout)
+        self.server_local = KVServer(self.po_local)
+        self.server_local.set_request_handle(
+            lambda req, kvs, srv: self._handle(req, kvs, srv, global_tier=False))
+        # startup barrier, local tier (reference: kvstore_dist.h:246)
+        self.po_local.barrier(psbase.ALL_GROUP, timeout=600.0)
+        if self.po_global is not None:
+            self.po_global.start(timeout)
+            if self.is_global_server:
+                self.server_global = KVServer(self.po_global)
+                self.server_global.set_request_handle(
+                    lambda req, kvs, srv: self._handle(req, kvs, srv,
+                                                       global_tier=True))
+            else:
+                self.worker_global = KVWorker(self.po_global)
+                # config commands re-broadcast by the global server arrive on
+                # the global overlay (reference: kvstore_dist_server.h:311-318)
+                self.worker_global.set_request_handle(
+                    lambda req, kvs, srv: self._handle(req, kvs, srv,
+                                                       global_tier=True))
+        if self.po_global is not None:
+            # startup barrier, global tier (reference: kvstore_dist.h:249-251)
+            self.po_global.barrier(psbase.ALL_GROUP, timeout=600.0)
+        self._ready.set()
+
+    def run(self) -> None:
+        """Blocking server loop (reference: kvstore_dist_server.h:114-130)."""
+        self.start()
+        while not self._stop.wait(0.2):
+            pass
+        self.shutdown()
+
+    def shutdown(self) -> None:
+        try:
+            self.po_local.finalize(do_barrier=True)
+        finally:
+            if self.po_global is not None:
+                self.po_global.finalize(do_barrier=True)
+
+    # ------------------------------------------------------------------
+    # request entry (reference: DataHandleEx, kvstore_dist_server.h:432)
+    # ------------------------------------------------------------------
+
+    def _handle(self, req: ReqMeta, kvs: KVPairs, srv: KVServer,
+                global_tier: bool) -> None:
+        if not self._ready.is_set():
+            self._ready.wait(600.0)
+        if req.simple_app:
+            self._handle_command(req, srv, global_tier)
+            return
+        global_store = self.is_global_server or global_tier
+        acts: List[Action] = []
+        with self._lock:
+            for i, key in enumerate(kvs.keys):
+                off = kvs.offset_of(i)
+                total = kvs.total_of(i)
+                if req.push:
+                    val = np.asarray(kvs.vals[i]).ravel()
+                    if kvs.compr:
+                        val = self.gc.decompress_push(
+                            kvs.compr, val, kvs.aux[i], kvs.len_of(i) or val.size)
+                    total = total or val.size
+                    self._key_total[key] = max(self._key_total.get(key, 0), total)
+                    if global_store:
+                        acts += self._push_global_store(
+                            req, srv, key, off, val, total, global_tier)
+                    else:
+                        acts += self._push_local_store(req, srv, key, off, val,
+                                                       total)
+                elif req.pull:
+                    length = kvs.len_of(i)
+                    if global_store:
+                        acts += self._pull_global_store(
+                            req, srv, key, off, length, total, kvs.compr)
+                    else:
+                        acts += self._pull_local_store(req, srv, key, off)
+        for fn in acts:
+            fn()
+
+    # ------------------------------------------------------------------
+    # party (intra-DC) server: push (reference: DataHandleSyncDefault)
+    # ------------------------------------------------------------------
+
+    def _push_local_store(self, req, srv, key, off, val, total) -> List[Action]:
+        st = self._state(key, off)
+        if st.stored is None:
+            # init-on-first-push (reference: kvstore_dist_server.h:1241);
+            # kv.init marks its pushes DATA_INIT — a gradient should never
+            # arrive first (workers init+pull before training)
+            if req.head != DATA_INIT:
+                log.warning("first push for key %d is not an init push", key)
+            st.stored = val.copy()
+            st.length, st.total = val.size, total
+            st.dtype = val.dtype
+            if self.has_global_tier:
+                # authoritative params live on the global tier: ack the init,
+                # then pull them back before serving any local pull
+                # (reference: DataPullFromGlobalServersDefault at :1274)
+                return [lambda: srv.response(req),
+                        lambda: self._global_pull(key, off)]
+            st.initialized = True
+            return [lambda: srv.response(req)] + self._flush_pulls(st, key)
+
+        # aggregate (reference: :1288-1298)
+        if not st.push_reqs:
+            st.merged = val.astype(np.float32, copy=True)
+        else:
+            st.merged += val
+        st.push_reqs.extend([(req, srv)] * max(req.num_merge, 1))
+        if len(st.push_reqs) < self.po_local.num_workers:
+            return []
+
+        # round complete (reference: :1324)
+        st.rounds += 1
+        reqs, st.push_reqs = st.push_reqs, []
+
+        if not self.has_global_tier:
+            # single-tier PS: apply the update here
+            new_w = (self.updater((key, off), st.merged, st.stored)
+                     if self.updater else st.merged)
+            st.stored = np.asarray(new_w, dtype=st.dtype).ravel()
+            st.initialized = True
+            st.version += 1
+            return ([lambda r=r, s=s: s.response(r) for r, s in reqs]
+                    + self._flush_pulls(st, key))
+
+        if self.use_hfa and st.rounds % self.period_k2 != 0:
+            # HFA local round: store the averaged weights, ack immediately
+            # (reference: :1327-1333)
+            st.stored = st.merged.astype(st.dtype)
+            st.version += 1
+            return [lambda r=r, s=s: s.response(r) for r, s in reqs]
+
+        if self.use_hfa:
+            # milestone delta (reference: :1334-1338)
+            if st.milestone is None:
+                st.milestone = st.stored.astype(np.float32, copy=True)
+            payload = (st.merged - st.milestone) / max(
+                self.po_global.num_workers, 1)
+        else:
+            payload = st.merged
+        # staging: store_ holds the outbound aggregate until the pull-back
+        # overwrites it with fresh params (reference store_ dual use, :519)
+        st.stored = payload.astype(st.dtype)
+        st.deferred_acks = reqs
+        return [lambda: self._forward_to_global(key, off)]
+
+    # ------------------------------------------------------------------
+    # global store: push (init / FSA aggregate / MixedSync)
+    # ------------------------------------------------------------------
+
+    def _push_global_store(self, req, srv, key, off, val, total,
+                           from_global_tier) -> List[Action]:
+        ranges = self._canonical_ranges(key, total)
+        acts: List[Action] = []
+        touched = False
+        for rng in ranges:
+            lo = max(off, rng.offset)
+            hi = min(off + val.size, rng.offset + rng.length)
+            if lo >= hi:
+                continue
+            touched = True
+            sub = val[lo - off:hi - off]
+            acts += self._global_slice_push(req, srv, key, rng, lo, sub,
+                                            total, from_global_tier)
+        if not touched:
+            log.warning("push key=%d off=%d total=%d missed all canonical "
+                        "ranges of global rank %d", key, off, total,
+                        self.po_global.my_rank if self.po_global else -1)
+            acts.append(lambda: srv.response(req))
+        return acts
+
+    def _global_slice_push(self, req, srv, key, rng, lo, sub, total,
+                           from_global_tier) -> List[Action]:
+        st = self._state(key, rng.offset)
+        if st.stored is None:
+            st.stored = np.zeros(rng.length, dtype=sub.dtype)
+            st.length, st.total = rng.length, total
+            st.dtype = sub.dtype
+
+        if not st.initialized:
+            if req.head != DATA_INIT:
+                # a party's forwarded gradient raced ahead of the master's
+                # init: buffer and replay once initialization completes
+                # (the reference would mis-store it as init data)
+                st.pre_init_pushes.append(
+                    (req, srv, rng, lo, sub, total, from_global_tier))
+                return []
+            # initialization pushes fill the canonical range (master worker's
+            # init; reference: :1241-1262 + initialized_ flag)
+            st.stored[lo - rng.offset:lo - rng.offset + sub.size] = sub
+            st.init_elems += sub.size
+            acts: List[Action] = [lambda: srv.response(req)]
+            if st.init_elems >= st.length:
+                st.initialized = True
+                acts += self._flush_pulls(st, key)
+                replay, st.pre_init_pushes = st.pre_init_pushes, []
+                for r, s, rg, l, sb, t, fg in replay:
+                    acts += self._global_slice_push(r, s, key, rg, l, sb, t, fg)
+            return acts
+        if req.head == DATA_INIT:
+            # late/duplicate init (other parties' rank-0 workers): ignore
+            return [lambda: srv.response(req)]
+
+        if not from_global_tier and not self.cfg.enable_central_worker:
+            # central-worker gradients ignored (reference: :1281); unlike the
+            # reference we still ack so the pusher never hangs
+            return [lambda: srv.response(req)]
+
+        if not self.sync_global_mode:
+            # MixedSync: update per arriving push, no barrier (reference:
+            # DataHandleAsyncDefault :1532)
+            grad = np.zeros(st.length, dtype=np.float32)
+            grad[lo - rng.offset:lo - rng.offset + sub.size] = sub
+            new_w = (self.updater((key, rng.offset), grad, st.stored)
+                     if self.updater else st.stored)
+            st.stored = np.asarray(new_w, dtype=st.dtype).ravel()
+            st.version += 1
+            return [lambda: srv.response(req)]
+
+        # FSA: element-weighted counted aggregation
+        if st.merged is None:
+            st.merged = np.zeros(st.length, dtype=np.float32)
+            st.elems_received = 0
+        st.merged[lo - rng.offset:lo - rng.offset + sub.size] += sub
+        st.elems_received += sub.size
+        st.push_reqs.append((req, srv))
+        if st.elems_received < st.length * self._num_expected_global():
+            return []
+
+        # global round complete: run the optimizer (reference: :1305-1319)
+        st.rounds += 1
+        new_w = (self.updater((key, rng.offset), st.merged, st.stored)
+                 if self.updater else st.merged)
+        st.stored = np.asarray(new_w, dtype=st.dtype).ravel()
+        st.merged = None
+        st.elems_received = 0
+        st.version += 1
+        reqs, st.push_reqs = st.push_reqs, []
+        return ([lambda r=r, s=s: s.response(r) for r, s in reqs]
+                + self._flush_pulls(st, key))
+
+    def _num_expected_global(self) -> int:
+        n = self.po_global.num_workers if self.po_global else 1
+        if self.is_global_server and self.cfg.enable_central_worker:
+            n += self.po_local.num_workers
+        return n
+
+    # ------------------------------------------------------------------
+    # pull paths
+    # ------------------------------------------------------------------
+
+    def _pull_local_store(self, req, srv, key, off) -> List[Action]:
+        st = self._state(key, off)
+        if not st.initialized:
+            st.pending_pulls.append((req, srv, off, 0))
+            return []
+        return [self._pull_response_action(st, req, srv, key, off, 0, "")]
+
+    def _pull_global_store(self, req, srv, key, off, length, total,
+                           req_compr) -> List[Action]:
+        total = total or self._key_total.get(key, 0)
+        acts: List[Action] = []
+        for rng in self._canonical_ranges(key, total):
+            req_lo = off
+            req_hi = off + (length or rng.length + rng.offset - off)
+            if req_hi <= rng.offset or req_lo >= rng.offset + rng.length:
+                continue
+            st = self._state(key, rng.offset)
+            if not st.initialized:
+                st.pending_pulls.append((req, srv, off, length))
+                continue
+            acts.append(self._pull_response_action(st, req, srv, key, off,
+                                                   length, req_compr))
+        return acts
+
+    def _pull_response_action(self, st: _KeyState, req, srv, key,
+                              req_off: int, req_len: int,
+                              req_compr: str) -> Action:
+        """Build the response closure for one pull against state ``st``."""
+        if req_len:
+            lo = max(req_off, st.offset)
+            hi = min(req_off + req_len, st.offset + st.length)
+        else:
+            lo, hi = st.offset, st.offset + st.length
+        data = st.stored[lo - st.offset:hi - st.offset]
+        if req_compr == "bsc" and self.updater is not None:
+            # BSC pull-compression assumes the store holds a SPARSE gradient
+            # aggregate (no server-side optimizer — reference cnn_bsc.py uses
+            # a local Trainer); with an updater the store is dense weights
+            # and the non-zero filter would truncate them. Serve dense.
+            if not getattr(self, "_warned_bsc_dense", False):
+                self._warned_bsc_dense = True
+                log.warning("BSC pull-compression disabled: an optimizer is "
+                            "set, the store holds dense weights")
+            req_compr = ""
+        if req_compr:
+            # pull-side compression on the WAN hop (reference:
+            # DefaultStorageResponse BSC branch, :1190-1210)
+            payload, aux = self.gc.compress_pull(
+                req_compr, data, self._pull_compress_factor())
+            out = KVPairs(keys=[key], vals=[payload], aux=[aux],
+                          offsets=[lo], totals=[st.total],
+                          lens=[hi - lo], compr=req_compr)
+        else:
+            out = KVPairs(keys=[key], vals=[data.copy()], offsets=[lo],
+                          totals=[st.total], lens=[hi - lo])
+        return lambda: srv.response(req, out)
+
+    def _pull_compress_factor(self) -> int:
+        return max(self.po_global.num_workers if self.po_global else 1, 1)
+
+    def _flush_pulls(self, st: _KeyState, key: int) -> List[Action]:
+        acts = []
+        pulls, st.pending_pulls = st.pending_pulls, []
+        for req, srv, off, length in pulls:
+            acts.append(self._pull_response_action(st, req, srv, key, off,
+                                                   length, ""))
+        return acts
+
+    # ------------------------------------------------------------------
+    # party server -> global tier forwarding
+    # (reference: DataPushToGlobalServers* :745-830, push-ack counting
+    #  :936-950, pull-back assembly :952-1167)
+    # ------------------------------------------------------------------
+
+    def _forward_to_global(self, key: int, off: int) -> None:
+        with self._lock:
+            st = self._state(key, off)
+            payload = st.stored
+            total = st.total
+            slices = self._global_slices(key, off, st.length, total)
+            st.fwd_acks_left = len(slices)
+        for g_rank, lo, hi in slices:
+            sub = np.ascontiguousarray(payload[lo - off:hi - off])
+            wire_val, aux, compr = self.gc.compress_push(sub, (key, lo))
+            kvs = KVPairs(keys=[key], vals=[wire_val], aux=[aux],
+                          offsets=[lo], totals=[total], lens=[hi - lo],
+                          compr=compr)
+            self.worker_global.push(
+                kvs, g_rank,
+                cb=lambda _ts, k=key, o=off: self._on_global_push_ack(k, o))
+
+    def _global_slices(self, key, off, length, total):
+        """Overlaps of this server's shard with global canonical ranges."""
+        out = []
+        for rng in sharding.assign(key, total, self.po_global.num_servers,
+                                   self.cfg.bigarray_bound):
+            lo = max(off, rng.offset)
+            hi = min(off + length, rng.offset + rng.length)
+            if lo < hi:
+                out.append((rng.server_rank, lo, hi))
+        return out
+
+    def _on_global_push_ack(self, key: int, off: int) -> None:
+        issue = False
+        with self._lock:
+            st = self._state(key, off)
+            st.fwd_acks_left -= 1
+            if st.fwd_acks_left == 0:
+                issue = True
+        if issue:
+            self._global_pull(key, off)
+
+    def _global_pull(self, key: int, off: int) -> None:
+        with self._lock:
+            st = self._state(key, off)
+            slices = self._global_slices(key, off, st.length, st.total)
+            st.fwd_expected = len(slices)
+            st.fwd_parts = {}
+            total = st.total
+        for g_rank, lo, hi in slices:
+            self.worker_global.pull(
+                [key], g_rank, offsets=[lo], totals=[total], lens=[hi - lo],
+                compr=self.gc.pull_compr_tag(hi - lo),
+                cb=lambda ts, k=key, o=off, l=lo, h=hi:
+                    self._on_global_pull_data(k, o, l, h, ts))
+
+    def _on_global_pull_data(self, key, off, lo, hi, ts) -> None:
+        resps = self.worker_global.take_response(ts)
+        acts: List[Action] = []
+        with self._lock:
+            st = self._state(key, off)
+            for kvs in resps:
+                for i, _k in enumerate(kvs.keys):
+                    data = np.asarray(kvs.vals[i]).ravel()
+                    if kvs.compr:
+                        data = self.gc.decompress_pull(
+                            kvs.compr, data, kvs.aux[i], kvs.len_of(i) or hi - lo,
+                            self._pull_compress_factor())
+                    r_off = kvs.offset_of(i)
+                    lo2 = max(lo, r_off)
+                    hi2 = min(hi, r_off + data.size)
+                    st.fwd_parts[lo2] = data[lo2 - r_off:hi2 - r_off]
+            if len(st.fwd_parts) >= st.fwd_expected and st.fwd_expected > 0:
+                acts = self._complete_global_round(st, key)
+        for fn in acts:
+            fn()
+
+    def _complete_global_round(self, st: _KeyState, key: int) -> List[Action]:
+        assembled = np.concatenate(
+            [st.fwd_parts[o] for o in sorted(st.fwd_parts)]).astype(np.float32)
+        st.fwd_parts = {}
+        st.fwd_expected = 0
+        if assembled.size != st.length:
+            log.warning("assembled %d elems for key %d shard of %d",
+                        assembled.size, key, st.length)
+        if self.use_hfa and st.milestone is not None:
+            # stored = milestone + pulled delta; milestone follows
+            # (reference: :993-998)
+            st.stored = (st.milestone + assembled).astype(st.dtype)
+            st.milestone = st.stored.astype(np.float32, copy=True)
+        elif self.use_hfa:
+            # first pull-back: milestone is born from the CURRENT stored
+            # values; the pulled data is intentionally not applied
+            # (reference: :988-992 — CopyFromTo(stored, milestone) only)
+            st.milestone = st.stored.astype(np.float32, copy=True)
+        else:
+            st.stored = assembled.astype(st.dtype)
+        st.initialized = True
+        st.version += 1
+        acks, st.deferred_acks = st.deferred_acks, []
+        acts: List[Action] = [lambda r=r, s=s: s.response(r) for r, s in acks]
+        acts += self._flush_pulls(st, key)
+        return acts
+
+    # ------------------------------------------------------------------
+    # command channel (reference: kvstore_dist_server.h:286-430)
+    # ------------------------------------------------------------------
+
+    def _handle_command(self, req: ReqMeta, srv: KVServer,
+                        global_tier: bool) -> None:
+        head, body = req.head, req.body
+        if head == Command.STOP_SERVER:
+            srv.response(req)
+            if self.is_global_server:
+                # stop only once every global worker has cascaded its stop
+                # (reference: kvstore_dist_server.h:290-295)
+                with self._lock:
+                    self._stops_received += 1
+                    n_gw = self.po_global.num_workers if self.po_global else 0
+                    done = self._stops_received >= max(n_gw, 1)
+                if done:
+                    self._stop.set()
+            else:
+                self._cascade_stop()
+                self._stop.set()
+            return
+        if head == Command.GLOBAL_BARRIER:
+            self._handle_global_barrier(req, srv)
+            return
+        if head == Command.SYNC_MODE:
+            self.sync_mode = body != "0"
+        elif head == Command.SYNC_GLOBAL_MODE:
+            self.sync_global_mode = body != "0"
+        elif head == Command.CONTROLLER:
+            self.updater = _safe_unpickle(bytes.fromhex(body))
+        elif head == Command.SET_GRADIENT_COMPRESSION:
+            self.gc = make_compressor(json.loads(body))
+        elif head == Command.SET_PROFILER_PARAMS:
+            pass  # profiler integration lands with the aux subsystems
+        srv.response(req)
+        if not global_tier:
+            self._rebroadcast_command(head, body)
+
+    def _handle_global_barrier(self, req: ReqMeta, srv: KVServer) -> None:
+        """Cross-party worker barrier: when all local workers arrived, this
+        server joins a global-overlay barrier over every party server and
+        global server, then releases its workers. Gives kv.barrier(
+        is_global=True) true all-party semantics (the reference's
+        kWorkerGroupGlobal barrier, kvstore_dist.h:208-211)."""
+        with self._lock:
+            if not hasattr(self, "_gb_reqs"):
+                self._gb_reqs = []
+            self._gb_reqs.append((req, srv))
+            if len(self._gb_reqs) < self.po_local.num_workers:
+                return
+            reqs, self._gb_reqs = self._gb_reqs, []
+        if self.po_global is not None:
+            # party servers + global servers all participate
+            self.po_global.barrier(psbase.WORKER_SERVER_GROUP, timeout=600.0)
+        for r, s in reqs:
+            s.response(r)
+
+    def _rebroadcast_command(self, head: int, body: str) -> None:
+        """A global server re-broadcasts config commands to its peers
+        (reference: kvstore_dist_server.h:311-318)."""
+        if not self.is_global_server or self.po_global is None:
+            return
+        if head not in (Command.CONTROLLER, Command.SET_GRADIENT_COMPRESSION,
+                        Command.SYNC_GLOBAL_MODE):
+            return
+        # both tiers: other global servers + party servers (global workers)
+        targets = [psbase.server_rank_to_id(r)
+                   for r in range(self.po_global.num_servers)]
+        targets += [psbase.worker_rank_to_id(r)
+                    for r in range(self.po_global.num_workers)]
+        for nid in targets:
+            if nid == self.po_global.my_id:
+                continue
+            self.po_global.van.send(Message(Meta(
+                recver=nid, app_id=0, customer_id=0, timestamp=-1,
+                request=True, simple_app=True, head=head, body=body,
+            )))
+
+    def _cascade_stop(self) -> None:
+        """Every party server forwards StopServer to the global servers,
+        which count them (reference: :296-301)."""
+        with self._lock:
+            if self._stop_forwarded:
+                return
+            self._stop_forwarded = True
+        if self.worker_global is not None:
+            for rank in range(self.po_global.num_servers):
+                try:
+                    ts = self.worker_global.request(
+                        Command.STOP_SERVER, "", psbase.server_rank_to_id(rank))
+                    self.worker_global.wait(ts, 10.0)
+                except (TimeoutError, OSError):
+                    pass
+
+    # ------------------------------------------------------------------
+
+    def _state(self, key: int, offset: int) -> _KeyState:
+        return self._states.setdefault((key, offset), _KeyState(offset))
+
+    def _canonical_ranges(self, key: int, total: int) -> List[sharding.Shard]:
+        """This global server's canonical shard(s) of ``key``."""
+        po = self.po_global if self.po_global else self.po_local
+        my_rank = po.my_rank
+        n = po.num_servers
+        return [s for s in sharding.assign(key, total, n,
+                                           self.cfg.bigarray_bound)
+                if s.server_rank == my_rank]

@@ -1,0 +1,45 @@
+"""Key -> server assignment and big-array splitting.
+
+Re-implements the reference's EncodeDefaultKey heuristics (reference:
+src/kvstore/kvstore_dist.h:725-816): arrays smaller than
+MXNET_KVSTORE_BIGARRAY_BOUND go whole to one server chosen by
+``(key * 9973) % num_servers``; larger arrays are split evenly across all
+servers. Used identically at both tiers (worker->local servers and
+local server->global servers) — the MultiGPS central-party trick (master
+worker's local servers ARE the global servers, scripts/cpu/run_multi_gps.sh)
+requires the two tiers' shardings to agree when server counts match.
+
+Unlike the reference (positional wire-key ranges), shards carry explicit
+(offset, total) element addressing — see ps.kv_app.KVPairs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+
+@dataclasses.dataclass(frozen=True)
+class Shard:
+    server_rank: int
+    offset: int   # element offset into the flat key
+    length: int   # element count of this shard
+    total: int    # total element count of the key
+
+
+def assign(key: int, num_elems: int, num_servers: int, bigarray_bound: int) -> List[Shard]:
+    """Shard a key across servers (reference: kvstore_dist.h:739-762)."""
+    if num_servers <= 1 or num_elems < bigarray_bound:
+        rank = (key * 9973) % max(num_servers, 1)
+        return [Shard(rank, 0, num_elems, num_elems)]
+    shards = []
+    base_len = num_elems // num_servers
+    rem = num_elems % num_servers
+    off = 0
+    for rank in range(num_servers):
+        ln = base_len + (1 if rank < rem else 0)
+        if ln == 0:
+            continue
+        shards.append(Shard(rank, off, ln, num_elems))
+        off += ln
+    return shards

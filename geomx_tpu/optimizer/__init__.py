@@ -1,1 +1,158 @@
-"""geomx_tpu.optimizer — placeholder (real implementation landing next)."""
+"""Optimizers that can run on the global aggregation server.
+
+Mirrors the reference's pattern of shipping a pickled Python optimizer from
+the master worker to the global server, where it runs as the updater
+(reference: python/mxnet/kvstore.py:452 set_optimizer -> pickled ->
+kvstore_server.py:55-60 controller -> kvstore_dist_server.h:507-519
+ApplyUpdates, which runs updater_ only when ps::IsGlobalServer()).
+
+These implementations are numpy-first (the global server is a host-side
+process; the arrays it updates are parameter-server shards, typically small
+slices), with a jit path used by the in-step data-parallel trainer in
+``geomx_tpu.parallel`` via optax. All classes are picklable by construction
+(plain attributes only) so they can travel over the command channel.
+
+Includes DCASGD (reference: python/mxnet/optimizer/optimizer.py:872-930),
+the delay-compensated ASGD used with MixedSync on the global server.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["Optimizer", "SGD", "Adam", "DCASGD", "create"]
+
+
+class Optimizer:
+    """Base optimizer: stateful per-key update ``w <- f(w, g)``."""
+
+    def __init__(self, learning_rate: float = 0.01, wd: float = 0.0,
+                 rescale_grad: float = 1.0, clip_gradient: Optional[float] = None):
+        self.learning_rate = learning_rate
+        self.wd = wd
+        self.rescale_grad = rescale_grad
+        self.clip_gradient = clip_gradient
+        self._states: Dict = {}
+
+    # -- subclass API ----------------------------------------------------
+
+    def create_state(self, key, weight: np.ndarray):
+        return None
+
+    def step(self, key, weight: np.ndarray, grad: np.ndarray, state) -> np.ndarray:
+        raise NotImplementedError
+
+    # -- entry point -----------------------------------------------------
+
+    def update(self, key, weight: np.ndarray, grad: np.ndarray) -> np.ndarray:
+        """Return the updated weight (accepts numpy or jax arrays)."""
+        grad = np.asarray(grad, dtype=np.float32) * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = np.clip(grad, -self.clip_gradient, self.clip_gradient)
+        if key not in self._states:
+            self._states[key] = self.create_state(key, weight)
+        return self.step(key, np.asarray(weight, dtype=np.float32), grad,
+                         self._states[key])
+
+    # kvstore updater signature: updater(key, grad, weight) -> new weight
+    def __call__(self, key, grad: np.ndarray, weight: np.ndarray) -> np.ndarray:
+        return self.update(key, weight, grad)
+
+    def get_states(self):
+        return self._states
+
+    def set_states(self, states) -> None:
+        self._states = states
+
+
+class SGD(Optimizer):
+    """SGD with optional momentum and weight decay."""
+
+    def __init__(self, learning_rate: float = 0.01, momentum: float = 0.0, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.momentum = momentum
+
+    def create_state(self, key, weight):
+        if self.momentum == 0.0:
+            return None
+        return np.zeros_like(weight, dtype=np.float32)
+
+    def step(self, key, weight, grad, state):
+        grad = grad + self.wd * weight
+        if state is None:
+            return weight - self.learning_rate * grad
+        state *= self.momentum
+        state += grad
+        return weight - self.learning_rate * state
+
+
+class Adam(Optimizer):
+    """Adam (Kingma & Ba). Matches mx.optimizer.Adam hyperparameter names."""
+
+    def __init__(self, learning_rate: float = 0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, key, weight):
+        return {
+            "t": 0,
+            "m": np.zeros_like(weight, dtype=np.float32),
+            "v": np.zeros_like(weight, dtype=np.float32),
+        }
+
+    def step(self, key, weight, grad, state):
+        grad = grad + self.wd * weight
+        state["t"] += 1
+        t = state["t"]
+        m, v = state["m"], state["v"]
+        m *= self.beta1
+        m += (1 - self.beta1) * grad
+        v *= self.beta2
+        v += (1 - self.beta2) * np.square(grad)
+        mhat = m / (1 - self.beta1 ** t)
+        vhat = v / (1 - self.beta2 ** t)
+        return weight - self.learning_rate * mhat / (np.sqrt(vhat) + self.epsilon)
+
+
+class DCASGD(Optimizer):
+    """Delay-Compensated ASGD (reference: optimizer.py:872-930).
+
+    Used by MixedSync on the global server: compensates gradient staleness
+    with the term ``lambda * g * g * (w - w_prev)`` where ``w_prev`` is the
+    weight snapshot from when the (stale) gradient departed.
+    """
+
+    def __init__(self, learning_rate: float = 0.01, momentum: float = 0.0,
+                 lamda: float = 0.04, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, key, weight):
+        mom = None if self.momentum == 0.0 else np.zeros_like(weight, np.float32)
+        return {"mom": mom, "prev": np.array(weight, dtype=np.float32, copy=True)}
+
+    def step(self, key, weight, grad, state):
+        prev = state["prev"]
+        comp = grad + self.wd * weight + self.lamda * grad * grad * (weight - prev)
+        if state["mom"] is not None:
+            state["mom"] *= self.momentum
+            state["mom"] -= self.learning_rate * comp
+            new_w = weight + state["mom"]
+        else:
+            new_w = weight - self.learning_rate * comp
+        state["prev"] = np.array(new_w, dtype=np.float32, copy=True)
+        return new_w
+
+
+_REGISTRY = {"sgd": SGD, "adam": Adam, "dcasgd": DCASGD}
+
+
+def create(name: str, **kwargs) -> Optimizer:
+    """Create an optimizer by name (mirrors mx.optimizer.create)."""
+    return _REGISTRY[name.lower()](**kwargs)

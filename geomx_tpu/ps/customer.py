@@ -32,6 +32,9 @@ class Customer:
         self._cv = threading.Condition(self._lock)
         # ts -> [num_expected, num_received]
         self._tracker: Dict[int, list] = {}
+        # callback-driven requests are never wait()ed; auto-drop their
+        # tracker entries on completion to avoid unbounded growth
+        self._auto_clear: set = set()
         self._next_ts = 0
         self._thread = threading.Thread(
             target=self._receiving, name=f"customer-{app_id}-{customer_id}", daemon=True
@@ -40,11 +43,13 @@ class Customer:
 
     # -- request lifecycle (reference: customer.h:66-90) -----------------
 
-    def new_request(self, num_responses: int) -> int:
+    def new_request(self, num_responses: int, auto_clear: bool = False) -> int:
         with self._lock:
             ts = self._next_ts
             self._next_ts += 1
             self._tracker[ts] = [num_responses, 0]
+            if auto_clear:
+                self._auto_clear.add(ts)
             return ts
 
     def wait_request(self, ts: int, timeout: Optional[float] = None) -> None:
@@ -71,6 +76,10 @@ class Customer:
         with self._cv:
             if ts in self._tracker:
                 self._tracker[ts][1] += n
+                if (ts in self._auto_clear
+                        and self._tracker[ts][1] >= self._tracker[ts][0]):
+                    self._tracker.pop(ts)
+                    self._auto_clear.discard(ts)
                 self._cv.notify_all()
 
     # -- inbound ---------------------------------------------------------
@@ -79,11 +88,20 @@ class Customer:
         self._queue.put(msg)
 
     def _receiving(self) -> None:
+        import logging
+
+        log = logging.getLogger("geomx.customer")
         while True:
             msg = self._queue.get()
             if msg is None:
                 return
-            self.recv_handle(msg)
+            try:
+                self.recv_handle(msg)
+            except Exception:
+                # a handler crash must not kill the processing thread —
+                # that would silently hang every later request
+                log.exception("recv handler failed (app=%s cid=%s)",
+                              self.app_id, self.customer_id)
             if not msg.meta.request and msg.meta.timestamp >= 0:
                 self.add_response(msg.meta.timestamp)
 

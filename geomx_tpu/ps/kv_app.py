@@ -32,16 +32,38 @@ KV_APP_ID = 0
 
 @dataclasses.dataclass
 class KVPairs:
-    """keys + one value array per key (reference: kv_app.h:39-77)."""
+    """keys + one value array per key (reference: kv_app.h:39-77).
+
+    ``offsets``/``totals`` implement shard addressing for big-array
+    splitting: entry i says "this value is elements [offsets[i],
+    offsets[i]+len) of key keys[i], whose full size is totals[i]". The
+    reference encodes the same information positionally through per-server
+    wire-key ranges (kvstore_dist.h:725-816 EncodeDefaultKey); explicit
+    offsets are simpler and survive re-sharding across tiers.
+    """
 
     keys: List[int] = dataclasses.field(default_factory=list)
     vals: List[np.ndarray] = dataclasses.field(default_factory=list)
     # optional per-key auxiliary arrays (e.g. BSC indices)
     aux: List[Optional[np.ndarray]] = dataclasses.field(default_factory=list)
+    # shard addressing; empty means "whole key" for every entry
+    offsets: List[int] = dataclasses.field(default_factory=list)
+    totals: List[int] = dataclasses.field(default_factory=list)
+    # pull requests only: requested element count per key (0 = whole shard)
+    lens: List[int] = dataclasses.field(default_factory=list)
     compr: str = ""
 
     def __len__(self) -> int:
         return len(self.keys)
+
+    def offset_of(self, i: int) -> int:
+        return self.offsets[i] if i < len(self.offsets) else 0
+
+    def total_of(self, i: int) -> int:
+        return self.totals[i] if i < len(self.totals) else 0
+
+    def len_of(self, i: int) -> int:
+        return self.lens[i] if i < len(self.lens) else 0
 
 
 @dataclasses.dataclass
@@ -66,6 +88,13 @@ class ReqMeta:
 def _pack_kv(meta: Meta, kvs: KVPairs) -> Message:
     msg = Message(meta=meta)
     msg.add_array(np.asarray(kvs.keys, dtype=np.int64))
+    n = len(kvs.keys)
+    offs = list(kvs.offsets) + [0] * (n - len(kvs.offsets))
+    tots = list(kvs.totals) + [0] * (n - len(kvs.totals))
+    lens = list(kvs.lens) + [0] * (n - len(kvs.lens))
+    msg.add_array(np.asarray(offs, dtype=np.int64))
+    msg.add_array(np.asarray(tots, dtype=np.int64))
+    msg.add_array(np.asarray(lens, dtype=np.int64))
     aux_mask = []
     for i, v in enumerate(kvs.vals):
         msg.add_array(np.asarray(v))
@@ -87,10 +116,15 @@ def _unpack_kv(msg: Message) -> KVPairs:
     keys = [int(k) for k in arrays[0]] if len(arrays) else []
     kvs = KVPairs(keys=keys, compr=msg.meta.compr)
     nkeys = len(keys)
+    if nkeys:
+        kvs.offsets = [int(x) for x in arrays[1]]
+        kvs.totals = [int(x) for x in arrays[2]]
+        kvs.lens = [int(x) for x in arrays[3]]
+    first_val = 4
     if msg.meta.aux_len and msg.meta.aux_mask:
         # aux arrays interleaved after their value part
         bits = bin(msg.meta.aux_mask)[2:].zfill(msg.meta.aux_len)
-        idx = 1
+        idx = first_val
         for i in range(nkeys):
             kvs.vals.append(arrays[idx])
             idx += 1
@@ -100,7 +134,7 @@ def _unpack_kv(msg: Message) -> KVPairs:
             else:
                 kvs.aux.append(None)
     else:
-        kvs.vals = arrays[1:1 + nkeys]
+        kvs.vals = arrays[first_val:first_val + nkeys]
         kvs.aux = [None] * nkeys
     return kvs
 
@@ -130,9 +164,13 @@ class KVWorker:
         iters: int = 0,
         num_merge: int = 1,
         pull: bool = False,
+        cb: Optional[Callable[[], None]] = None,
     ) -> int:
         """ZPush (reference: kv_app.h:219). Response = 1 server ack."""
-        ts = self.customer.new_request(1)
+        ts = self.customer.new_request(1, auto_clear=cb is not None)
+        if cb is not None:
+            with self._lock:
+                self._callbacks[ts] = cb
         meta = Meta(
             recver=base.server_rank_to_id(server_rank),
             app_id=KV_APP_ID,
@@ -155,12 +193,16 @@ class KVWorker:
         keys: List[int],
         server_rank: int,
         *,
+        offsets: Optional[List[int]] = None,
+        totals: Optional[List[int]] = None,
+        lens: Optional[List[int]] = None,
         cmd: int = 0,
         priority: int = 0,
+        compr: str = "",
         cb: Optional[Callable[[], None]] = None,
     ) -> int:
         """ZPull (reference: kv_app.h:324)."""
-        ts = self.customer.new_request(1)
+        ts = self.customer.new_request(1, auto_clear=cb is not None)
         with self._lock:
             self._responses[ts] = []
             if cb is not None:
@@ -176,7 +218,14 @@ class KVWorker:
             head=cmd,
             priority=priority,
         )
-        kvs = KVPairs(keys=list(keys), vals=[np.zeros(0, np.float32)] * len(keys))
+        kvs = KVPairs(
+            keys=list(keys),
+            vals=[np.zeros(0, np.float32)] * len(keys),
+            offsets=list(offsets or []),
+            totals=list(totals or []),
+            lens=list(lens or []),
+            compr=compr,
+        )
         self.po.van.send(_pack_kv(meta, kvs))
         return ts
 
@@ -222,9 +271,10 @@ class KVWorker:
             kvs = _unpack_kv(msg)
             with self._lock:
                 self._responses.setdefault(ts, []).append(kvs)
-        cb = self._callbacks.pop(ts, None) if self._callbacks else None
+        with self._lock:
+            cb = self._callbacks.pop(ts, None)
         if cb is not None:
-            cb()
+            cb(ts)  # callbacks receive the request timestamp
 
     _request_handle: Optional[Callable] = None
 

@@ -74,12 +74,19 @@ class Postoffice:
             self.van.my_id,
         )
 
-    def finalize(self, do_barrier: bool = True) -> None:
+    def finalize(self, do_barrier: bool = True,
+                 barrier_timeout: float = 600.0) -> None:
+        """Exit protocol: one ALL-group barrier, then teardown.
+
+        Every tier member performs exactly two ALL-group barriers over its
+        lifetime — one at startup, one here — so the scheduler's passive
+        exit-wait (kvstore_server._run_scheduler) aligns with the rounds.
+        """
         if not self._started:
             return
         if do_barrier:
             try:
-                self.barrier(base.ALL_GROUP, timeout=30.0)
+                self.barrier(base.ALL_GROUP, timeout=barrier_timeout)
             except (TimeoutError, OSError):
                 log.warning("finalize barrier failed; stopping anyway")
         with self._customers_lock:

@@ -201,6 +201,10 @@ class Van:
             if base.is_group(recver)
             else [recver]
         )
+        # deliver any self-loopback LAST: a loopback can wake the local
+        # waiter (e.g. a barrier release), which may tear the van down
+        # while the remaining remote sends are still in flight
+        targets = sorted(targets, key=lambda t: t == self.my_id)
         total = 0
         for t in targets:
             if t == self.my_id and msg.is_control:

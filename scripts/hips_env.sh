@@ -1,0 +1,61 @@
+#!/bin/bash
+# Shared topology wiring for the single-host HiPS demo: 12 processes,
+# 3 parties (reference: scripts/cpu/run_vanilla_hips.sh — central party with
+# global scheduler + global server + master worker + scheduler; two data
+# parties with scheduler + server + 2 workers each).
+# Usage: source hips_env.sh; launch_hips <worker_script> [extra args...]
+
+REPO_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="$REPO_DIR${PYTHONPATH:+:$PYTHONPATH}"
+GPORT=${GPORT:-9092}; CPORT=${CPORT:-9093}; APORT=${APORT:-9094}; BPORT=${BPORT:-9095}
+PYTHON=${PYTHON:-python}
+INFRA="-c \"import geomx_tpu\""
+
+GLOBALS="DMLC_PS_GLOBAL_ROOT_URI=127.0.0.1 DMLC_PS_GLOBAL_ROOT_PORT=$GPORT \
+DMLC_NUM_GLOBAL_SERVER=1 DMLC_NUM_GLOBAL_WORKER=2"
+
+launch_hips() {
+  local script="$1"; shift
+  local extra="$@"
+
+  # central party -----------------------------------------------------
+  env $(echo $GLOBALS) DMLC_ROLE_GLOBAL=global_scheduler \
+    $PYTHON -c "import geomx_tpu" > /tmp/hips_gsched.log 2>&1 &
+  env DMLC_ROLE=scheduler DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$CPORT \
+    DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=1 \
+    $PYTHON -c "import geomx_tpu" > /tmp/hips_csched.log 2>&1 &
+  env $(echo $GLOBALS) DMLC_ROLE_GLOBAL=global_server DMLC_ROLE=server \
+    DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$CPORT \
+    DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=1 DMLC_ENABLE_CENTRAL_WORKER=0 \
+    DMLC_NUM_ALL_WORKER=4 \
+    $PYTHON -c "import geomx_tpu" > /tmp/hips_gserver.log 2>&1 &
+  env DMLC_ROLE=worker DMLC_ROLE_MASTER_WORKER=1 \
+    DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$CPORT \
+    DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=1 DMLC_NUM_ALL_WORKER=4 \
+    $PYTHON $script $extra > /tmp/hips_master.log 2>&1 &
+
+  # data parties ------------------------------------------------------
+  local slice=0
+  for PPORT in $APORT $BPORT; do
+    env DMLC_ROLE=scheduler DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$PPORT \
+      DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=2 \
+      $PYTHON -c "import geomx_tpu" > /tmp/hips_sched_$PPORT.log 2>&1 &
+    env $(echo $GLOBALS) DMLC_ROLE=server \
+      DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$PPORT \
+      DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=2 \
+      $PYTHON -c "import geomx_tpu" > /tmp/hips_server_$PPORT.log 2>&1 &
+    for w in 0 1; do
+      if [ "$PPORT" = "$BPORT" ] && [ "$w" = "1" ]; then
+        # last worker runs in the foreground (reference pattern)
+        env DMLC_ROLE=worker DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$PPORT \
+          DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=2 DMLC_NUM_ALL_WORKER=4 \
+          $PYTHON -u $script --data-slice-idx $slice $extra
+      else
+        env DMLC_ROLE=worker DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$PPORT \
+          DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=2 DMLC_NUM_ALL_WORKER=4 \
+          $PYTHON $script --data-slice-idx $slice $extra > /tmp/hips_w$slice.log 2>&1 &
+      fi
+      slice=$((slice+1))
+    done
+  done
+}

@@ -1,0 +1,380 @@
+"""Integration tests: the full HiPS two-tier topology, in one process.
+
+Replicates the reference's 12-process, 3-party demo topology
+(scripts/cpu/run_vanilla_hips.sh) as in-process threads: a central party
+(global scheduler, global server, master worker, scheduler) plus two data
+parties (scheduler, server, two workers each). Because Postoffices are
+instance-scoped, no subprocesses or env vars are needed — configs are
+passed explicitly.
+"""
+
+import socket
+import threading
+from typing import List
+
+import numpy as np
+import pytest
+
+from geomx_tpu.config import Config
+from geomx_tpu.kvstore.dist import KVStoreDist
+from geomx_tpu.kvstore.server import KVStoreDistServer
+from geomx_tpu.optimizer import SGD, Adam
+from geomx_tpu.ps import base as psbase
+from geomx_tpu.ps.message import Role
+from geomx_tpu.ps.postoffice import Postoffice
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class Topology:
+    """Builds and tears down a HiPS topology of in-process nodes."""
+
+    def __init__(self, num_parties=2, workers_per_party=2, num_global_servers=1,
+                 use_hfa=False, hfa_k2=1, enable_central_worker=False):
+        self.gport = free_port()
+        self.cports = [free_port() for _ in range(num_parties + 1)]  # [0]=central
+        self.num_parties = num_parties
+        self.wpp = workers_per_party
+        self.ngs = num_global_servers
+        self.ngw = num_parties  # each party server is a global worker
+        self.num_all = num_parties * workers_per_party
+        self.use_hfa = use_hfa
+        self.hfa_k2 = hfa_k2
+        self.ecw = enable_central_worker
+        self.threads: List[threading.Thread] = []
+        self.servers: List[KVStoreDistServer] = []
+        self.workers: List[KVStoreDist] = []
+        self.master: KVStoreDist = None
+        self.errors: List[BaseException] = []
+
+    def _common(self, **kw) -> Config:
+        base = dict(
+            ps_global_root_uri="127.0.0.1", ps_global_root_port=self.gport,
+            num_global_workers=self.ngw, num_global_servers=self.ngs,
+            num_all_workers=self.num_all, use_hfa=self.use_hfa,
+            hfa_k2=self.hfa_k2, enable_central_worker=self.ecw,
+        )
+        base.update(kw)
+        return Config(**base)
+
+    def _spawn(self, fn, *args):
+        def runner():
+            try:
+                fn(*args)
+            except BaseException as e:  # noqa: BLE001 — surface in test
+                self.errors.append(e)
+
+        t = threading.Thread(target=runner, daemon=True)
+        t.start()
+        self.threads.append(t)
+        return t
+
+    def _run_sched(self, root_port, is_global, nw, ns):
+        po = Postoffice(
+            my_role=Role.SCHEDULER, is_global=is_global,
+            root_uri="127.0.0.1", root_port=root_port,
+            num_workers=nw, num_servers=ns, cfg=Config(),
+        )
+        po.start(60.0)
+        po.barrier(psbase.ALL_GROUP, timeout=60.0)    # startup round
+        po.barrier(psbase.ALL_GROUP, timeout=300.0)   # exit round
+        po.van.stop()
+
+    def start(self, sync_global=True):
+        # global scheduler
+        self._spawn(self._run_sched, self.gport, True, self.ngw, self.ngs)
+        # central party scheduler (1 worker = master, 1 server = global server)
+        self._spawn(self._run_sched, self.cports[0], False, 1, self.ngs)
+        # global server(s) = central party server(s)
+        for _ in range(self.ngs):
+            cfg = self._common(
+                role="server", role_global="global_server",
+                ps_root_uri="127.0.0.1", ps_root_port=self.cports[0],
+                num_workers=1, num_servers=self.ngs,
+            )
+            srv = KVStoreDistServer(cfg)
+            self.servers.append(srv)
+            self._spawn(srv.run)
+        # party schedulers + servers + workers
+        worker_boxes = []
+        for p in range(self.num_parties):
+            port = self.cports[p + 1]
+            self._spawn(self._run_sched, port, False, self.wpp, 1)
+            cfg = self._common(
+                role="server",
+                ps_root_uri="127.0.0.1", ps_root_port=port,
+                num_workers=self.wpp, num_servers=1,
+            )
+            srv = KVStoreDistServer(cfg)
+            self.servers.append(srv)
+            self._spawn(srv.run)
+            for _ in range(self.wpp):
+                wcfg = self._common(
+                    role="worker",
+                    ps_root_uri="127.0.0.1", ps_root_port=port,
+                    num_workers=self.wpp, num_servers=1,
+                )
+                box = []
+                worker_boxes.append(box)
+                self._spawn(lambda b=box, c=wcfg, s=sync_global:
+                            b.append(KVStoreDist(sync_global=s, cfg=c)))
+        # master worker
+        mcfg = self._common(
+            role="worker", is_master_worker=True,
+            ps_root_uri="127.0.0.1", ps_root_port=self.cports[0],
+            num_workers=1, num_servers=self.ngs,
+        )
+        mbox = []
+        self._spawn(lambda: mbox.append(KVStoreDist(sync_global=sync_global,
+                                                    cfg=mcfg)))
+        # wait for all kvstores to construct
+        for _ in range(600):
+            if self.errors:
+                raise self.errors[0]
+            if len(mbox) == 1 and all(len(b) == 1 for b in worker_boxes):
+                break
+            threading.Event().wait(0.1)
+        assert len(mbox) == 1, "master worker failed to start"
+        assert all(len(b) == 1 for b in worker_boxes), "workers failed to start"
+        self.master = mbox[0]
+        self.workers = [b[0] for b in worker_boxes]
+        return self
+
+    def stop(self):
+        # closes must run concurrently: each member joins the exit barrier
+        # (in production every process closes independently)
+        closers = [w.close for w in self.workers]
+        if self.master is not None:
+            closers.append(self.master.close)
+        _parallel(closers)
+        for t in self.threads:
+            t.join(30)
+        if self.errors:
+            raise self.errors[0]
+
+
+def _parallel(fns):
+    errs = []
+
+    def wrap(fn):
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=wrap, args=(fn,), daemon=True) for fn in fns]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(120)
+    if errs:
+        raise errs[0]
+
+
+def test_hips_fsa_vanilla():
+    """Vanilla dist_sync: SGD(lr=1) on the global server; 2 parties x 2
+    workers each push ones -> after one round every worker pulls w0 - 4."""
+    topo = Topology().start(sync_global=True)
+    try:
+        topo.master.set_optimizer(SGD(learning_rate=1.0))
+        w0 = np.arange(40, dtype=np.float32).reshape(5, 8)
+
+        def init_on(kv):
+            kv.init(0, w0)
+            if not kv.is_master_worker:
+                got = kv.pull(0)
+                np.testing.assert_allclose(got.reshape(5, 8), w0)
+
+        _parallel([lambda kv=kv: init_on(kv)
+                   for kv in topo.workers + [topo.master]])
+
+        def train_step(kv):
+            kv.push(0, np.ones((5, 8), np.float32))
+            out = np.zeros((5, 8), np.float32)
+            kv.pull(0, out=out)
+            kv.wait()
+            np.testing.assert_allclose(out, w0 - 4.0)
+
+        _parallel([lambda kv=kv: train_step(kv) for kv in topo.workers])
+
+        # second round: w0 - 8 everywhere
+        def step2(kv):
+            kv.push(0, np.ones((5, 8), np.float32))
+            out = np.zeros((5, 8), np.float32)
+            kv.pull(0, out=out)
+            kv.wait()
+            np.testing.assert_allclose(out, w0 - 8.0)
+
+        _parallel([lambda kv=kv: step2(kv) for kv in topo.workers])
+    finally:
+        topo.stop()
+
+
+def test_hips_multiple_keys_and_adam():
+    topo = Topology().start(sync_global=True)
+    try:
+        topo.master.set_optimizer(Adam(learning_rate=0.01))
+        shapes = {0: (4, 4), 1: (16,), 2: (3, 2, 2)}
+        w0 = {k: np.random.RandomState(k).randn(*s).astype(np.float32)
+              for k, s in shapes.items()}
+
+        def init_on(kv):
+            for k in shapes:
+                kv.init(k, w0[k])
+
+        _parallel([lambda kv=kv: init_on(kv)
+                   for kv in topo.workers + [topo.master]])
+
+        outs = {}
+        lock = threading.Lock()
+
+        def train(kv):
+            grads = {k: np.full(shapes[k], 0.1, np.float32) for k in shapes}
+            for k in shapes:
+                kv.push(k, grads[k], priority=-k)
+            res = {k: np.zeros(shapes[k], np.float32) for k in shapes}
+            for k in shapes:
+                kv.pull(k, out=res[k], priority=-k)
+            kv.wait()
+            with lock:
+                outs[kv.rank, id(kv)] = res
+
+        _parallel([lambda kv=kv: train(kv) for kv in topo.workers])
+        vals = list(outs.values())
+        for other in vals[1:]:
+            for k in shapes:
+                np.testing.assert_allclose(vals[0][k], other[k], rtol=1e-6)
+        for k in shapes:  # Adam moved every weight
+            assert not np.allclose(vals[0][k], w0[k])
+    finally:
+        topo.stop()
+
+
+def test_hips_mixed_sync_async_global():
+    """dist_async (MixedSync): global tier updates per party push."""
+    topo = Topology().start(sync_global=False)
+    try:
+        topo.master.set_optimizer(SGD(learning_rate=1.0))
+        w0 = np.zeros(8, np.float32)
+        _parallel([lambda kv=kv: kv.init(0, w0)
+                   for kv in topo.workers + [topo.master]])
+
+        def train(kv):
+            kv.push(0, np.ones(8, np.float32))
+            out = np.zeros(8, np.float32)
+            kv.pull(0, out=out)
+            kv.wait()
+            # each party contributes -2; depending on arrival order a worker
+            # sees one or both parties applied
+            assert out[0] in (-2.0, -4.0), out
+
+        _parallel([lambda kv=kv: train(kv) for kv in topo.workers])
+        # both parties' push acks returned, so the global store has both
+        # updates; the master worker's local server IS the global server,
+        # so its pull reads the global store directly
+        final = topo.master.pull(0)
+        np.testing.assert_allclose(final, np.full(8, -4.0))
+    finally:
+        topo.stop()
+
+
+def test_hips_bsc_gradient_aggregation():
+    """BSC mode: no global optimizer; the store carries the aggregated
+    gradient; workers pull it (into param.grad() in the examples) and apply
+    the optimizer locally (reference: examples/cnn_bsc.py:115-121)."""
+    topo = Topology().start(sync_global=True)
+    try:
+        topo.master.set_gradient_compression({"type": "bsc", "threshold": 1.0})
+        w0 = np.full(64, 7.0, np.float32)
+        _parallel([lambda kv=kv: kv.init(0, w0)
+                   for kv in topo.workers + [topo.master]])
+
+        def train(kv):
+            kv.push(0, np.full(64, 0.25, np.float32))
+            out = np.zeros(64, np.float32)
+            kv.pull(0, out=out)
+            kv.wait()
+            # 4 workers x 0.25, summed through both tiers
+            np.testing.assert_allclose(out, np.full(64, 1.0), rtol=1e-5)
+
+        _parallel([lambda kv=kv: train(kv) for kv in topo.workers])
+    finally:
+        topo.stop()
+
+
+def test_single_tier_classic_ps():
+    """No global tier: a classic 1-scheduler/1-server/2-worker PS where the
+    local server applies the optimizer (stock-MXNet dist behavior)."""
+    port = free_port()
+    threads = []
+    errors = []
+
+    def run(fn):
+        def w():
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+        t = threading.Thread(target=w, daemon=True)
+        t.start()
+        threads.append(t)
+
+    sched_po = Postoffice(my_role=Role.SCHEDULER, is_global=False,
+                          root_uri="127.0.0.1", root_port=port,
+                          num_workers=2, num_servers=1, cfg=Config())
+
+    def sched():
+        sched_po.start(60)
+        sched_po.barrier(psbase.ALL_GROUP, timeout=60)
+        sched_po.barrier(psbase.ALL_GROUP, timeout=120)
+        sched_po.van.stop()
+
+    run(sched)
+    scfg = Config(role="server", ps_root_uri="127.0.0.1", ps_root_port=port,
+                  num_workers=2, num_servers=1)
+    srv = KVStoreDistServer(scfg)
+    run(srv.run)
+    boxes = [[], []]
+    for i in range(2):
+        wcfg = Config(role="worker", ps_root_uri="127.0.0.1",
+                      ps_root_port=port, num_workers=2, num_servers=1)
+        run(lambda b=boxes[i], c=wcfg: b.append(KVStoreDist(cfg=c)))
+    for _ in range(300):
+        if errors:
+            raise errors[0]
+        if all(len(b) == 1 for b in boxes):
+            break
+        threading.Event().wait(0.1)
+    kvs = [b[0] for b in boxes]
+    try:
+        rank0 = next(kv for kv in kvs if kv.rank == 0)
+        rank0.set_optimizer(SGD(learning_rate=0.5))
+        w0 = np.ones(10, np.float32)
+        _parallel([lambda kv=kv: kv.init(3, w0) for kv in kvs])
+
+        def train(kv):
+            kv.push(3, np.ones(10, np.float32))
+            out = np.zeros(10, np.float32)
+            kv.pull(3, out=out)
+            kv.wait()
+            np.testing.assert_allclose(out, np.zeros(10))  # 1 - 0.5*2
+
+        _parallel([lambda kv=kv: train(kv) for kv in kvs])
+    finally:
+        _parallel([kv.close for kv in kvs])
+        for t in threads:
+            t.join(30)
+        if errors:
+            raise errors[0]
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))
