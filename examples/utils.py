@@ -1,0 +1,93 @@
+"""Shared example harness (reference: examples/utils.py).
+
+Provides the data loaders (via geomx_tpu.io), a jitted train/eval step pair
+for the demo CNN, flat parameter<->pytree plumbing for the KVStore integer
+key space, and the Measure JSON reporter (reference: examples/utils.py:120).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from geomx_tpu.io import load_data  # noqa: F401  (re-export)
+from geomx_tpu.models import create_cnn
+
+
+def build_model_and_step(batch_size: int, compute_dtype=jnp.float32,
+                         num_classes: int = 10):
+    """Returns (param_leaves, treedef, grad_step, eval_step).
+
+    grad_step(leaves, X, y) -> (loss, grad_leaves); mean-normalized grads
+    (the reference pushes grad/num_samples, examples/cnn.py:123 — MXNet
+    grads are per-batch sums; JAX mean-loss grads are already normalized).
+    """
+    model = create_cnn(num_classes=num_classes, compute_dtype=compute_dtype)
+    rng = jax.random.PRNGKey(42)  # same init on every worker process
+    params = model.init(rng, jnp.zeros((1, 28, 28, 1), jnp.float32))
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+
+    def loss_fn(leaf_list, X, y):
+        p = jax.tree_util.tree_unflatten(treedef, leaf_list)
+        logits = model.apply(p, X)
+        one_hot = jax.nn.one_hot(y, num_classes)
+        loss = -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * one_hot, axis=-1))
+        return loss
+
+    @jax.jit
+    def grad_step(leaf_list, X, y):
+        loss, grads = jax.value_and_grad(loss_fn)(leaf_list, X, y)
+        return loss, grads
+
+    @jax.jit
+    def eval_step(leaf_list, X, y):
+        p = jax.tree_util.tree_unflatten(treedef, leaf_list)
+        pred = jnp.argmax(model.apply(p, X), axis=-1)
+        return jnp.mean((pred == y).astype(jnp.float32))
+
+    # writable host copies (np.asarray of a jax array is a read-only view)
+    return ([np.array(l, copy=True) for l in leaves], treedef, grad_step,
+            eval_step)
+
+
+def eval_acc(test_iter, leaves: List[np.ndarray], eval_step) -> float:
+    accs = []
+    jleaves = [jnp.asarray(l) for l in leaves]
+    for X, y in test_iter:
+        accs.append(float(eval_step(jleaves, jnp.asarray(X), jnp.asarray(y))))
+    return float(np.mean(accs)) if accs else 0.0
+
+
+class Measure:
+    """Per-iteration JSON metrics reporter (reference: utils.py:120)."""
+
+    def __init__(self, log_dir: str = "/tmp/geomx_logs", sub_dir: str = "run"):
+        self.begin = time.time()
+        self.records = []
+        self.log_path = os.path.join(log_dir, sub_dir)
+        os.makedirs(self.log_path, exist_ok=True)
+
+    def add(self, iteration: int, epoch: int, accuracy: float,
+            num_samples: int, loss: float = 0.0):
+        rec = {
+            "iteration": iteration,
+            "epoch": epoch,
+            "time": round(time.time() - self.begin, 4),
+            "accuracy": round(accuracy, 4),
+            "num_samples": num_samples,
+            "loss": round(float(loss), 5),
+        }
+        self.records.append(rec)
+        return rec
+
+    def dump(self, name: str = "measure.json"):
+        path = os.path.join(self.log_path, name)
+        with open(path, "w") as f:
+            json.dump(self.records, f)
+        return path

@@ -1,0 +1,3 @@
+"""Data loading (reference: src/io/ iterators + examples/utils.py loaders)."""
+
+from geomx_tpu.io.datasets import load_data, DataIter  # noqa: F401

@@ -1,0 +1,132 @@
+"""Datasets + iterators.
+
+Plays the role of the reference's IO layer (reference: src/io/iter_mnist.cc
+and examples/utils.py:39-118 load_data/SplitSampler): MNIST-family loading,
+per-worker contiguous slicing, optional non-IID split-by-class, batching.
+
+Loads real MNIST/Fashion-MNIST IDX files when present under ``root``
+(same file names the reference's gluon datasets download); otherwise falls
+back to a DETERMINISTIC synthetic class-conditional dataset — each class
+has a fixed random template, samples are template + noise — which is
+learnable, so per-iteration test accuracy (the reference's observable
+correctness signal, examples/cnn.py:129-131) still climbs.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+
+def _read_idx_images(path: str) -> np.ndarray:
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad idx image magic {magic}"
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(n, rows, cols)
+
+
+def _read_idx_labels(path: str) -> np.ndarray:
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad idx label magic {magic}"
+        return np.frombuffer(f.read(), dtype=np.uint8)
+
+
+def _try_load_idx(root: str, train: bool):
+    prefixes = ["train" if train else "t10k"]
+    for p in prefixes:
+        for suffix in ("", ".gz"):
+            img = os.path.join(root, f"{p}-images-idx3-ubyte{suffix}")
+            lab = os.path.join(root, f"{p}-labels-idx1-ubyte{suffix}")
+            if os.path.exists(img) and os.path.exists(lab):
+                return _read_idx_images(img), _read_idx_labels(lab)
+    return None
+
+
+def synthetic_mnist(n: int, seed: int, num_classes: int = 10,
+                    shape: Tuple[int, int] = (28, 28)):
+    """Deterministic learnable stand-in: class template + gaussian noise."""
+    rng = np.random.RandomState(1234)  # templates shared across workers
+    templates = rng.rand(num_classes, *shape).astype(np.float32)
+    sample_rng = np.random.RandomState(seed)
+    labels = sample_rng.randint(0, num_classes, size=n).astype(np.int32)
+    noise = sample_rng.normal(0, 0.35, size=(n, *shape)).astype(np.float32)
+    images = np.clip(templates[labels] + noise, 0.0, 1.0)
+    return images, labels
+
+
+class DataIter:
+    """Batched iterator over (images NHWC float32 in [0,1], labels int32)."""
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray,
+                 batch_size: int, shuffle: bool = True, seed: int = 0):
+        self.images = images
+        self.labels = labels
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+
+    def __len__(self) -> int:
+        return max(len(self.images) // self.batch_size, 1)
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        idx = np.arange(len(self.images))
+        if self.shuffle:
+            self._rng.shuffle(idx)
+        for i in range(len(self)):
+            sel = idx[i * self.batch_size:(i + 1) * self.batch_size]
+            yield self.images[sel], self.labels[sel]
+
+
+def load_data(batch_size: int,
+              num_workers: int = 1,
+              data_slice_idx: int = 0,
+              data_type: str = "mnist",
+              split_by_class: bool = False,
+              resize=None,
+              root: str = "/root/data",
+              synthetic_train_size: int = 4096,
+              synthetic_test_size: int = 1024):
+    """Mirror of the reference loader (examples/utils.py:39-90): returns
+    (train_iter, test_iter, num_train, num_test) with this worker's
+    contiguous slice (SplitSampler) or class-partitioned slice."""
+    assert data_slice_idx < num_workers, (
+        f"Invalid slice id ({data_slice_idx}), must be < num_workers "
+        f"({num_workers})")
+    droot = os.path.join(os.path.expanduser(root), data_type)
+    loaded = _try_load_idx(droot, train=True) if os.path.isdir(droot) else None
+    if loaded is not None:
+        train_x, train_y = loaded
+        test_x, test_y = _try_load_idx(droot, train=False)
+        train_x = train_x.astype(np.float32) / 255.0
+        test_x = test_x.astype(np.float32) / 255.0
+        train_y = train_y.astype(np.int32)
+        test_y = test_y.astype(np.int32)
+    else:
+        train_x, train_y = synthetic_mnist(synthetic_train_size, seed=7)
+        test_x, test_y = synthetic_mnist(synthetic_test_size, seed=11)
+
+    # per-worker slicing (reference: SplitSampler / ClassSplitSampler)
+    n = len(train_x)
+    if num_workers > 1:
+        if split_by_class:
+            order = np.argsort(train_y, kind="stable")
+        else:
+            order = np.arange(n)
+        part = n // num_workers
+        sel = order[data_slice_idx * part:(data_slice_idx + 1) * part]
+        train_x, train_y = train_x[sel], train_y[sel]
+
+    train_x = train_x[..., None]  # NHWC
+    test_x = test_x[..., None]
+    train_iter = DataIter(train_x, train_y, batch_size, shuffle=True,
+                          seed=100 + data_slice_idx)
+    test_iter = DataIter(test_x, test_y, batch_size, shuffle=False)
+    return train_iter, test_iter, len(train_x), len(test_x)

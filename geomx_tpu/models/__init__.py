@@ -1,0 +1,7 @@
+"""Model zoo (flax.linen) — TPU-first replacements for the reference's
+gluon model layer (reference: python/mxnet/gluon/model_zoo/ + the example
+CNN, examples/cnn.py:56-63).
+"""
+
+from geomx_tpu.models.cnn import LeNetCNN, create_cnn  # noqa: F401
+from geomx_tpu.models.mlp import MLP  # noqa: F401

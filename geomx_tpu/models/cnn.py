@@ -1,0 +1,45 @@
+"""The reference demo CNN, TPU-idiomatic.
+
+Architecture parity with the reference example (reference:
+examples/cnn.py:56-63): Conv(16,5x5)+relu -> maxpool(2,2) ->
+Conv(32,5x5)+relu -> maxpool(2,2) -> Dense(256)+relu -> Dense(128)+relu
+-> Dense(10). NHWC layout (TPU-native; the reference uses NCHW for cuDNN).
+
+Compute dtype is configurable: bfloat16 keeps the MXU fed on TPU while
+parameters stay float32 (the reference's fp16 example casts the whole net,
+examples/cnn_fp16.py — on TPU bf16 compute + f32 params is the idiomatic
+equivalent).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LeNetCNN(nn.Module):
+    num_classes: int = 10
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        # x: [N, H, W, C]
+        dt = self.compute_dtype
+        x = x.astype(dt)
+        x = nn.Conv(16, (5, 5), padding="VALID", dtype=dt)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(32, (5, 5), padding="VALID", dtype=dt)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(256, dtype=dt)(x))
+        x = nn.relu(nn.Dense(128, dtype=dt)(x))
+        x = nn.Dense(self.num_classes, dtype=dt)(x)
+        return x.astype(jnp.float32)
+
+
+def create_cnn(num_classes: int = 10, compute_dtype=jnp.float32) -> LeNetCNN:
+    return LeNetCNN(num_classes=num_classes, compute_dtype=compute_dtype)

@@ -1,0 +1,22 @@
+"""Simple MLP (parity with gluon Dense stacks used across the reference
+examples; also the cheapest end-to-end smoke model)."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (256, 128, 10)
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.compute_dtype)
+        for f in self.features[:-1]:
+            x = nn.relu(nn.Dense(f, dtype=self.compute_dtype)(x))
+        x = nn.Dense(self.features[-1], dtype=self.compute_dtype)(x)
+        return x.astype(jnp.float32)

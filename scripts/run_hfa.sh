@@ -1,0 +1,9 @@
+#!/bin/bash
+# HFA: K1 local steps per sync, global sync every K2 rounds
+# (reference: scripts/cpu/run_hfa.sh)
+cd "$(dirname "$0")"
+export MXNET_KVSTORE_USE_HFA=1
+export MXNET_KVSTORE_HFA_K1=${MXNET_KVSTORE_HFA_K1:-2}
+export MXNET_KVSTORE_HFA_K2=${MXNET_KVSTORE_HFA_K2:-2}
+source ./hips_env.sh
+launch_hips "$REPO_DIR/examples/cnn_hfa.py" --cpu "$@"

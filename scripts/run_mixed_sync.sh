@@ -1,0 +1,5 @@
+#!/bin/bash
+# Single-host HiPS demo: 12 processes, 3 parties (reference: scripts/cpu/run_mixed_sync.sh)
+cd "$(dirname "$0")"
+source ./hips_env.sh
+launch_hips "$REPO_DIR/examples/cnn.py" --cpu --mixed-sync "$@"
