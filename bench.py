@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Benchmark: flagship CNN training throughput, images/sec/chip.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+Metric parity with BASELINE.md: the reference's observable signal is
+examples/cnn.py per-iteration wall time on its demo CNN (2 conv + 3
+dense); the driver's target is >= 0.9x per-chip V100 throughput at
+accuracy parity. The reference publishes no V100 number (BASELINE.md), so
+``V100_BASELINE_IMG_S`` is our documented estimate for this model at this
+batch size on a V100 CUDA build; vs_baseline = value / (0.9 * estimate).
+
+The measured step is the full training step — forward + backward + Adam
+update — jitted on one chip, steady-state (compile excluded), on the
+28x28x1 input the reference uses.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from geomx_tpu.models import create_cnn
+
+# Documented estimate: the reference demo CNN (178k params) fwd+bwd+Adam
+# at batch 256 on a V100 (CUDA build). No published table exists
+# (BASELINE.md); 50k img/s is a generous estimate for this small model.
+V100_BASELINE_IMG_S = 50_000.0
+
+BATCH = 256
+WARMUP = 5
+ITERS = 30
+
+
+def main():
+    model = create_cnn(compute_dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+    X = jax.random.uniform(rng, (BATCH, 28, 28, 1), jnp.float32)
+    y = jax.random.randint(rng, (BATCH,), 0, 10)
+    params = model.init(rng, X[:1])
+    optimizer = optax.adam(1e-3)
+    opt_state = optimizer.init(params)
+
+    def loss_fn(p, X, y):
+        logits = model.apply(p, X)
+        oh = jax.nn.one_hot(y, 10)
+        return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * oh, axis=-1))
+
+    @jax.jit
+    def step(p, s, X, y):
+        loss, grads = jax.value_and_grad(loss_fn)(p, X, y)
+        updates, s = optimizer.update(grads, s, p)
+        p = optax.apply_updates(p, updates)
+        return p, s, loss
+
+    for _ in range(WARMUP):
+        params, opt_state, loss = step(params, opt_state, X, y)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        params, opt_state, loss = step(params, opt_state, X, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_s = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "cnn_train_images_per_sec_per_chip",
+        "value": round(img_s, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_s / (0.9 * V100_BASELINE_IMG_S), 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

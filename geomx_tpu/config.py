@@ -36,7 +36,7 @@ def env_bool(name: str, default: bool = False) -> bool:
     v = os.environ.get(name)
     if v is None or v == "":
         return default
-    return v not in ("0", "false", "False", "")
+    return v.strip().lower() not in ("0", "false", "no", "off")
 
 
 # Role constants (reference: postoffice.cc:22-53).

@@ -102,7 +102,7 @@ def load_data(batch_size: int,
         f"({num_workers})")
     droot = os.path.join(os.path.expanduser(root), data_type)
     loaded = _try_load_idx(droot, train=True) if os.path.isdir(droot) else None
-    if loaded is not None:
+    if loaded is not None and _try_load_idx(droot, train=False) is not None:
         train_x, train_y = loaded
         test_x, test_y = _try_load_idx(droot, train=False)
         train_x = train_x.astype(np.float32) / 255.0

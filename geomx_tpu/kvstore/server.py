@@ -392,14 +392,30 @@ class KVStoreDistServer:
             st.version += 1
             return [lambda: srv.response(req)]
 
-        # FSA: element-weighted counted aggregation
+        # FSA: element-counted aggregation. Each PARTY covers the canonical
+        # range exactly once per round across its local servers (a party's
+        # servers partition the key), and each enabled central worker covers
+        # it once — so the round completes at
+        #   length x (num_parties + central_workers)
+        # elements, with num_parties = num_global_workers / party servers
+        # (uniform party sizes — true of every reference topology; this
+        # generalizes the reference's aligned-wire-key counting,
+        # kvstore_dist_server.h:1305-1319, which deadlocks for multi-server
+        # parties).
         if st.merged is None:
             st.merged = np.zeros(st.length, dtype=np.float32)
             st.elems_received = 0
         st.merged[lo - rng.offset:lo - rng.offset + sub.size] += sub
         st.elems_received += sub.size
         st.push_reqs.append((req, srv))
-        if st.elems_received < st.length * self._num_expected_global():
+        if from_global_tier:
+            self._party_nsrv = max(req.party_nsrv, 1)
+        n_gw = self.po_global.num_workers if self.po_global else 1
+        n_parties = max(n_gw // max(getattr(self, "_party_nsrv", 1), 1), 1)
+        expected = n_parties
+        if self.is_global_server and self.cfg.enable_central_worker:
+            expected += self.po_local.num_workers
+        if st.elems_received < st.length * expected:
             return []
 
         # global round complete: run the optimizer (reference: :1305-1319)
@@ -414,11 +430,6 @@ class KVStoreDistServer:
         return ([lambda r=r, s=s: s.response(r) for r, s in reqs]
                 + self._flush_pulls(st, key))
 
-    def _num_expected_global(self) -> int:
-        n = self.po_global.num_workers if self.po_global else 1
-        if self.is_global_server and self.cfg.enable_central_worker:
-            n += self.po_local.num_workers
-        return n
 
     # ------------------------------------------------------------------
     # pull paths
@@ -512,7 +523,7 @@ class KVStoreDistServer:
                           offsets=[lo], totals=[total], lens=[hi - lo],
                           compr=compr)
             self.worker_global.push(
-                kvs, g_rank,
+                kvs, g_rank, party_nsrv=self.po_local.num_servers,
                 cb=lambda _ts, k=key, o=off: self._on_global_push_ack(k, o))
 
     def _global_slices(self, key, off, length, total):

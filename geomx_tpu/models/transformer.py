@@ -1,0 +1,105 @@
+"""Decoder-only transformer with pluggable (ring) attention.
+
+The long-context/distributed flagship: batch shards over "dp", sequence
+over "sp" (ring attention via shard_map+ppermute), heads and MLP hidden
+over "tp" (Megatron-style, via parameter shardings that GSPMD propagates).
+The reference has no attention-era model layer at all (SURVEY.md §5.7);
+this is the capability the TPU build adds as first-class.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def dense_attention(q, k, v, *, causal: bool = True):
+    """Plain attention fallback (single-device / no sp axis)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(d).astype(q.dtype)
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+class Block(nn.Module):
+    dim: int
+    heads: int
+    mlp_ratio: int = 4
+    attn_fn: Optional[Callable] = None
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        dt = self.compute_dtype
+        h = nn.LayerNorm(dtype=dt, name="ln1")(x)
+        qkv = nn.Dense(3 * self.dim, dtype=dt, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        hd = self.dim // self.heads
+        shp = (x.shape[0], x.shape[1], self.heads, hd)
+        attn = self.attn_fn or (lambda q, k, v: dense_attention(q, k, v))
+        o = attn(q.reshape(shp), k.reshape(shp), v.reshape(shp))
+        o = o.reshape(x.shape[0], x.shape[1], self.dim)
+        x = x + nn.Dense(self.dim, dtype=dt, name="proj")(o)
+        h = nn.LayerNorm(dtype=dt, name="ln2")(x)
+        h = nn.Dense(self.mlp_ratio * self.dim, dtype=dt, name="up")(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(self.dim, dtype=dt, name="down")(h)
+        return x
+
+
+class Transformer(nn.Module):
+    vocab: int = 256
+    dim: int = 128
+    depth: int = 2
+    heads: int = 4
+    max_len: int = 2048
+    attn_fn: Optional[Callable] = None
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens):
+        # tokens: [B, T] int32
+        dt = self.compute_dtype
+        x = nn.Embed(self.vocab, self.dim, dtype=dt, name="embed")(tokens)
+        pos = nn.Embed(self.max_len, self.dim, dtype=dt, name="pos")(
+            jnp.arange(tokens.shape[1])[None, :])
+        x = x + pos
+        for i in range(self.depth):
+            x = Block(self.dim, self.heads, attn_fn=self.attn_fn,
+                      compute_dtype=dt, name=f"block{i}")(x)
+        x = nn.LayerNorm(dtype=dt, name="lnf")(x)
+        return nn.Dense(self.vocab, dtype=dt, name="head")(x).astype(
+            jnp.float32)
+
+
+def transformer_param_sharding(mesh: Mesh):
+    """Megatron-style PartitionSpec rules by parameter path suffix."""
+
+    def spec_for(path: str, ndim: int) -> P:
+        if path.endswith("qkv/kernel") or path.endswith("up/kernel"):
+            return P(None, "tp")
+        if path.endswith("qkv/bias") or path.endswith("up/bias"):
+            return P("tp")
+        if path.endswith("proj/kernel") or path.endswith("down/kernel"):
+            return P("tp", None)
+        return P()  # embeddings, norms, head, remaining biases: replicated
+
+    def shard(params):
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+        def put(path_entries, leaf):
+            path = "/".join(str(getattr(p, "key", p)) for p in path_entries)
+            return jax.device_put(
+                leaf, NamedSharding(mesh, spec_for(path, leaf.ndim)))
+
+        return jax.tree_util.tree_map_with_path(put, params)
+
+    return shard

@@ -1,0 +1,21 @@
+"""geomx_tpu.parallel — device-mesh parallelism (the TPU-native tier 0/1).
+
+This is where the reference's intra-DC machinery dissolves into XLA:
+- intra-worker multi-device DP (reference: comm_->Reduce, src/kvstore/
+  comm.h:104-452) and intra-DC worker<->server push/pull (reference:
+  kvstore_dist.h:329-424) both lower to a psum inside a jitted train step
+  over the ICI mesh — no PS processes inside a slice;
+- tensor/sequence parallelism come from shardings over the same mesh
+  (GSPMD inserts the collectives);
+- ring attention (sequence/context parallelism for long sequences) is an
+  explicit shard_map + ppermute pipeline, a capability the reference
+  lacks entirely (SURVEY.md §5.7) but this framework treats as
+  first-class.
+"""
+
+from geomx_tpu.parallel.mesh import make_mesh, mesh_shape_for  # noqa: F401
+from geomx_tpu.parallel.train_step import (  # noqa: F401
+    DataParallelTrainer,
+    HierarchicalTrainer,
+)
+from geomx_tpu.parallel.ring_attention import ring_attention  # noqa: F401

@@ -1,0 +1,45 @@
+"""Mesh construction and sharding helpers.
+
+One mesh, named axes, shardings annotated at the jit boundary; XLA/GSPMD
+inserts the collectives (psum over "dp", all-gather/reduce-scatter over
+"tp", ppermute rings over "sp"). Axis convention:
+
+- "dp": data parallel (batch dimension)
+- "tp": tensor parallel (hidden/feature dimension)
+- "sp": sequence/context parallel (sequence dimension; ring attention)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: F401
+
+AXES = ("dp", "tp", "sp")
+
+
+def mesh_shape_for(n_devices: int, tp: int = 1, sp: int = 1) -> Tuple[int, int, int]:
+    """Factor n_devices into (dp, tp, sp) given tp/sp requests."""
+    assert n_devices % (tp * sp) == 0, (
+        f"n_devices={n_devices} not divisible by tp*sp={tp * sp}")
+    return (n_devices // (tp * sp), tp, sp)
+
+
+def make_mesh(devices: Optional[Sequence] = None, tp: int = 1,
+              sp: int = 1) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    dp, tp, sp = mesh_shape_for(len(devices), tp, sp)
+    arr = np.asarray(devices).reshape(dp, tp, sp)
+    return Mesh(arr, AXES)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh) -> NamedSharding:
+    """Batch split over dp (and sp when the model is sequence-parallel)."""
+    return NamedSharding(mesh, P("dp"))

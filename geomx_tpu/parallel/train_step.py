@@ -1,0 +1,117 @@
+"""Jitted train steps over the device mesh (tier 0/1) and the hierarchical
+trainer that composes them with the inter-DC KVStore (tier 2).
+
+The reference's intra-DC data path (worker Comm reduce + worker<->server
+push/pull, kvstore_dist.h:329-478) is HERE, as a single jitted step: the
+batch is sharded over "dp", gradients are mean-reduced by XLA-inserted
+collectives, and the optimizer update runs on-device. The hierarchical
+trainer then periodically exchanges the *aggregated* gradient/weights with
+the HiPS global tier through the host KVStore — the only part that
+touches the WAN.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class DataParallelTrainer:
+    """Pure in-mesh DP: params replicated, batch sharded over "dp"."""
+
+    def __init__(self, model, optimizer: optax.GradientTransformation,
+                 mesh: Mesh, example_input: jnp.ndarray,
+                 num_classes: int = 10, rng_seed: int = 42):
+        self.model = model
+        self.optimizer = optimizer
+        self.mesh = mesh
+        params = model.init(jax.random.PRNGKey(rng_seed), example_input)
+        self.repl = NamedSharding(mesh, P())
+        self.batch_shard = NamedSharding(mesh, P("dp"))
+        self.params = jax.device_put(params, self.repl)
+        self.opt_state = jax.device_put(optimizer.init(params), self.repl)
+        self.num_classes = num_classes
+
+        def loss_fn(p, X, y):
+            logits = model.apply(p, X)
+            one_hot = jax.nn.one_hot(y, num_classes)
+            return -jnp.mean(
+                jnp.sum(jax.nn.log_softmax(logits) * one_hot, axis=-1))
+
+        @jax.jit
+        def train_step(p, opt_state, X, y):
+            loss, grads = jax.value_and_grad(loss_fn)(p, X, y)
+            updates, opt_state = optimizer.update(grads, opt_state, p)
+            p = optax.apply_updates(p, updates)
+            return p, opt_state, loss
+
+        @jax.jit
+        def grad_step(p, X, y):
+            return jax.value_and_grad(loss_fn)(p, X, y)
+
+        self._train_step = train_step
+        self._grad_step = grad_step
+
+    def shard_batch(self, X, y):
+        return (jax.device_put(jnp.asarray(X), self.batch_shard),
+                jax.device_put(jnp.asarray(y), self.batch_shard))
+
+    def step(self, X, y) -> float:
+        X, y = self.shard_batch(X, y)
+        self.params, self.opt_state, loss = self._train_step(
+            self.params, self.opt_state, X, y)
+        return float(loss)
+
+    def grads(self, X, y):
+        """Mesh-aggregated (mean) gradients — tier-1 output for tier-2."""
+        X, y = self.shard_batch(X, y)
+        return self._grad_step(self.params, X, y)
+
+
+class HierarchicalTrainer:
+    """Tier-1 mesh aggregation + tier-2 HiPS exchange (geo-DP on TPU).
+
+    Replaces the reference worker's per-layer push/pull loop
+    (examples/cnn.py:121-124): the mesh IS the data center; the KVStore
+    carries only one aggregated gradient per key across the WAN. The
+    global server runs the optimizer (FSA semantics) and the fresh
+    parameters are installed back onto the mesh.
+    """
+
+    def __init__(self, trainer: DataParallelTrainer, kvstore,
+                 priority_by_key: bool = True):
+        self.t = trainer
+        self.kv = kvstore
+        self.priority_by_key = priority_by_key
+        leaves, self.treedef = jax.tree_util.tree_flatten(self.t.params)
+        self._shapes = [l.shape for l in leaves]
+        self._host = [np.array(l, copy=True) for l in leaves]
+
+    def init_on_kvstore(self) -> None:
+        for idx, leaf in enumerate(self._host):
+            self.kv.init(idx, leaf)
+            if not getattr(self.kv, "is_master_worker", False):
+                self.kv.pull(idx, out=self._host[idx])
+        self.kv.wait()
+        self._install()
+
+    def _install(self) -> None:
+        leaves = [jnp.asarray(h) for h in self._host]
+        self.t.params = jax.device_put(
+            jax.tree_util.tree_unflatten(self.treedef, leaves), self.t.repl)
+
+    def step(self, X, y) -> float:
+        loss, grads = self.t.grads(X, y)
+        glist = jax.tree_util.tree_leaves(grads)
+        for idx, g in enumerate(glist):
+            pr = -idx if self.priority_by_key else 0
+            self.kv.push(idx, np.asarray(g), priority=pr)
+            self.kv.pull(idx, out=self._host[idx], priority=pr)
+        self.kv.wait()
+        self._install()
+        return float(loss)

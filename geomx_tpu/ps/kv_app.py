@@ -83,6 +83,7 @@ class ReqMeta:
     iters: int
     compr: str
     num_merge: int
+    party_nsrv: int = 1
 
 
 def _pack_kv(meta: Meta, kvs: KVPairs) -> Message:
@@ -163,8 +164,9 @@ class KVWorker:
         version: int = 0,
         iters: int = 0,
         num_merge: int = 1,
+        party_nsrv: int = 1,
         pull: bool = False,
-        cb: Optional[Callable[[], None]] = None,
+        cb: Optional[Callable[[int], None]] = None,
     ) -> int:
         """ZPush (reference: kv_app.h:219). Response = 1 server ack."""
         ts = self.customer.new_request(1, auto_clear=cb is not None)
@@ -184,6 +186,7 @@ class KVWorker:
             version=version,
             iters=iters,
             num_merge=num_merge,
+            party_nsrv=party_nsrv,
         )
         self.po.van.send(_pack_kv(meta, kvs))
         return ts
@@ -199,9 +202,10 @@ class KVWorker:
         cmd: int = 0,
         priority: int = 0,
         compr: str = "",
-        cb: Optional[Callable[[], None]] = None,
+        cb: Optional[Callable[[int], None]] = None,
     ) -> int:
-        """ZPull (reference: kv_app.h:324)."""
+        """ZPull (reference: kv_app.h:324). ``cb`` receives the request
+        timestamp when the response arrives."""
         ts = self.customer.new_request(1, auto_clear=cb is not None)
         with self._lock:
             self._responses[ts] = []
@@ -332,6 +336,7 @@ def _req_meta_of(msg: Message) -> ReqMeta:
         iters=msg.meta.iters,
         compr=msg.meta.compr,
         num_merge=msg.meta.num_merge,
+        party_nsrv=msg.meta.party_nsrv,
     )
 
 

@@ -26,7 +26,7 @@ import dataclasses
 import enum
 import json
 import struct
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -148,6 +148,11 @@ class Meta:
     # TSEngine bookkeeping
     num_merge: int = 1
 
+    # number of local servers in the sending party (global-tier pushes);
+    # lets the global server weight round-completion counting so parties
+    # with multiple local servers aggregate correctly
+    party_nsrv: int = 1
+
     # aux-array layout for KV payloads (bitmask over keys; see kv_app._pack_kv)
     aux_mask: int = 0
     aux_len: int = 0
@@ -250,6 +255,45 @@ class Message:
         return self.meta.control_cmd != Control.EMPTY
 
 
+def read_message(sock) -> Optional[Tuple["Message", int]]:
+    """Read one message directly from a socket: (message, wire_bytes).
+
+    Avoids the join-then-reslice copies of read_frame+unpack — each data
+    part is received into its own buffer exactly once (hot-path for large
+    tensor payloads).
+    """
+    hdr = _read_exact(sock, _PREHDR.size)
+    if hdr is None:
+        return None
+    magic, recver, flags, priority, meta_len = _PREHDR.unpack(hdr)
+    if magic != MAGIC:
+        raise ValueError(f"bad frame magic {magic:#x}")
+    meta_b = _read_exact(sock, meta_len)
+    if meta_b is None:
+        return None
+    nd_b = _read_exact(sock, _U32.size)
+    if nd_b is None:
+        return None
+    (ndata,) = _U32.unpack(nd_b)
+    total = _PREHDR.size + meta_len + _U32.size
+    data: List[bytes] = []
+    for _ in range(ndata):
+        ln_b = _read_exact(sock, _U32.size)
+        if ln_b is None:
+            return None
+        (n,) = _U32.unpack(ln_b)
+        payload = _read_exact(sock, n)
+        if payload is None:
+            return None
+        data.append(payload)
+        total += _U32.size + n
+    meta = Meta.from_dict(json.loads(meta_b.decode()))
+    meta.recver = recver
+    meta.priority = priority
+    meta.is_global = bool(flags & FLAG_GLOBAL)
+    return Message(meta=meta, data=data), total
+
+
 def read_frame(sock) -> Optional[bytes]:
     """Read one complete frame from a socket-like object; None on EOF."""
     hdr = _read_exact(sock, _PREHDR.size)
@@ -280,15 +324,16 @@ def read_frame(sock) -> Optional[bytes]:
 
 
 def _read_exact(sock, n: int) -> Optional[bytes]:
-    chunks = []
-    remaining = n
-    while remaining > 0:
+    """Receive exactly n bytes into a single pre-allocated buffer."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
         try:
-            chunk = sock.recv(min(remaining, 1 << 20))
+            r = sock.recv_into(view[got:], n - got)
         except (ConnectionResetError, OSError):
             return None
-        if not chunk:
+        if r == 0:
             return None
-        chunks.append(chunk)
-        remaining -= len(chunk)
-    return b"".join(chunks)
+        got += r
+    return bytes(buf)

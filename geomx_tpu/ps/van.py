@@ -33,7 +33,8 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from geomx_tpu.ps import base
-from geomx_tpu.ps.message import Control, Message, Meta, Node, Role, read_frame
+from geomx_tpu.ps.message import (Control, Message, Meta, Node, Role,
+                                  read_message)
 
 log = logging.getLogger("geomx.van")
 
@@ -325,14 +326,14 @@ class Van:
     def _reader_loop(self, conn: socket.socket) -> None:
         while not self.stopped.is_set():
             try:
-                frame = read_frame(conn)
+                got = read_message(conn)
             except (ValueError, OSError):
                 break
-            if frame is None:
+            if got is None:
                 break
-            self.recv_bytes += len(frame)
+            msg, nbytes = got
+            self.recv_bytes += nbytes
             try:
-                msg = Message.unpack(frame)
                 if (
                     self.drop_rate > 0
                     and not msg.is_control

@@ -36,14 +36,17 @@ class Topology:
     """Builds and tears down a HiPS topology of in-process nodes."""
 
     def __init__(self, num_parties=2, workers_per_party=2, num_global_servers=1,
-                 use_hfa=False, hfa_k2=1, enable_central_worker=False):
+                 servers_per_party=1, use_hfa=False, hfa_k2=1,
+                 enable_central_worker=False, bigarray_bound=1000000):
         self.gport = free_port()
         self.cports = [free_port() for _ in range(num_parties + 1)]  # [0]=central
         self.num_parties = num_parties
         self.wpp = workers_per_party
         self.ngs = num_global_servers
-        self.ngw = num_parties  # each party server is a global worker
+        self.spp = servers_per_party
+        self.ngw = num_parties * servers_per_party
         self.num_all = num_parties * workers_per_party
+        self.bigarray_bound = bigarray_bound
         self.use_hfa = use_hfa
         self.hfa_k2 = hfa_k2
         self.ecw = enable_central_worker
@@ -59,6 +62,7 @@ class Topology:
             num_global_workers=self.ngw, num_global_servers=self.ngs,
             num_all_workers=self.num_all, use_hfa=self.use_hfa,
             hfa_k2=self.hfa_k2, enable_central_worker=self.ecw,
+            bigarray_bound=self.bigarray_bound,
         )
         base.update(kw)
         return Config(**base)
@@ -105,20 +109,21 @@ class Topology:
         worker_boxes = []
         for p in range(self.num_parties):
             port = self.cports[p + 1]
-            self._spawn(self._run_sched, port, False, self.wpp, 1)
-            cfg = self._common(
-                role="server",
-                ps_root_uri="127.0.0.1", ps_root_port=port,
-                num_workers=self.wpp, num_servers=1,
-            )
-            srv = KVStoreDistServer(cfg)
-            self.servers.append(srv)
-            self._spawn(srv.run)
+            self._spawn(self._run_sched, port, False, self.wpp, self.spp)
+            for _ in range(self.spp):
+                cfg = self._common(
+                    role="server",
+                    ps_root_uri="127.0.0.1", ps_root_port=port,
+                    num_workers=self.wpp, num_servers=self.spp,
+                )
+                srv = KVStoreDistServer(cfg)
+                self.servers.append(srv)
+                self._spawn(srv.run)
             for _ in range(self.wpp):
                 wcfg = self._common(
                     role="worker",
                     ps_root_uri="127.0.0.1", ps_root_port=port,
-                    num_workers=self.wpp, num_servers=1,
+                    num_workers=self.wpp, num_servers=self.spp,
                 )
                 box = []
                 worker_boxes.append(box)
@@ -302,6 +307,39 @@ def test_hips_bsc_gradient_aggregation():
             kv.wait()
             # 4 workers x 0.25, summed through both tiers
             np.testing.assert_allclose(out, np.full(64, 1.0), rtol=1e-5)
+
+        _parallel([lambda kv=kv: train(kv) for kv in topo.workers])
+    finally:
+        topo.stop()
+
+
+def test_hips_multi_server_parties():
+    """Two local servers per party: big keys split across them, each server
+    forwards its shard; the global server's party-weighted element counting
+    must complete the round (the reference's aligned-key counting cannot)."""
+    topo = Topology(servers_per_party=2, bigarray_bound=16).start(
+        sync_global=True)
+    try:
+        topo.master.set_optimizer(SGD(learning_rate=1.0))
+        # key 0: big (split across servers); key 1: small (hash-assigned)
+        w = {0: np.arange(40, dtype=np.float32), 1: np.ones(8, np.float32)}
+
+        def init_on(kv):
+            for k, v in w.items():
+                kv.init(k, v)
+
+        _parallel([lambda kv=kv: init_on(kv)
+                   for kv in topo.workers + [topo.master]])
+
+        def train(kv):
+            for k in w:
+                kv.push(k, np.ones_like(w[k]))
+            outs = {k: np.zeros_like(w[k]) for k in w}
+            for k in w:
+                kv.pull(k, out=outs[k])
+            kv.wait()
+            for k in w:
+                np.testing.assert_allclose(outs[k], w[k] - 4.0)
 
         _parallel([lambda kv=kv: train(kv) for kv in topo.workers])
     finally:
