@@ -102,9 +102,10 @@ def load_data(batch_size: int,
         f"({num_workers})")
     droot = os.path.join(os.path.expanduser(root), data_type)
     loaded = _try_load_idx(droot, train=True) if os.path.isdir(droot) else None
-    if loaded is not None and _try_load_idx(droot, train=False) is not None:
+    loaded_test = _try_load_idx(droot, train=False) if loaded is not None else None
+    if loaded is not None and loaded_test is not None:
         train_x, train_y = loaded
-        test_x, test_y = _try_load_idx(droot, train=False)
+        test_x, test_y = loaded_test
         train_x = train_x.astype(np.float32) / 255.0
         test_x = test_x.astype(np.float32) / 255.0
         train_y = train_y.astype(np.int32)

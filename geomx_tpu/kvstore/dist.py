@@ -121,6 +121,11 @@ class KVStoreDist(KVStore):
     # -- helpers ---------------------------------------------------------
 
     def _shards(self, key: int, total: int) -> List[sharding.Shard]:
+        if self.cfg.enable_p3:
+            # P3: slice every key at bigarray granularity so the priority
+            # send thread can interleave layers (kvstore_dist.h:768-805)
+            return sharding.assign_p3(key, total, self.po.num_servers,
+                                      self.cfg.bigarray_bound)
         return sharding.assign(key, total, self.po.num_servers,
                                self.cfg.bigarray_bound)
 

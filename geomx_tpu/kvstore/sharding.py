@@ -43,3 +43,29 @@ def assign(key: int, num_elems: int, num_servers: int, bigarray_bound: int) -> L
         shards.append(Shard(rank, off, ln, num_elems))
         off += ln
     return shards
+
+
+def assign_p3(key: int, num_elems: int, num_servers: int,
+              slice_bound: int) -> List[Shard]:
+    """P3 slicing (reference: P3_EncodeDefaultKey, kvstore_dist.h:768-805).
+
+    Every key — regardless of size — is cut into slices of at most
+    ``slice_bound`` elements, assigned round-robin over servers starting at
+    the key's hash server. Each slice travels as its own message, so the
+    worker van's priority send queue can let a later (higher-priority,
+    needed-sooner-on-the-next-forward) layer's small slices overtake an
+    earlier layer's bulk — the essence of P3's slicing + priority
+    scheduling.
+    """
+    n = max(num_servers, 1)
+    start = (key * 9973) % n
+    bound = max(slice_bound, 1)
+    shards = []
+    off = 0
+    i = 0
+    while off < num_elems or not shards:
+        ln = min(bound, num_elems - off)
+        shards.append(Shard((start + i) % n, off, ln, num_elems))
+        off += ln
+        i += 1
+    return shards

@@ -83,7 +83,7 @@ class Transformer(nn.Module):
 def transformer_param_sharding(mesh: Mesh):
     """Megatron-style PartitionSpec rules by parameter path suffix."""
 
-    def spec_for(path: str, ndim: int) -> P:
+    def spec_for(path: str) -> P:
         if path.endswith("qkv/kernel") or path.endswith("up/kernel"):
             return P(None, "tp")
         if path.endswith("qkv/bias") or path.endswith("up/bias"):
@@ -93,12 +93,10 @@ def transformer_param_sharding(mesh: Mesh):
         return P()  # embeddings, norms, head, remaining biases: replicated
 
     def shard(params):
-        flat = jax.tree_util.tree_flatten_with_path(params)[0]
-
         def put(path_entries, leaf):
             path = "/".join(str(getattr(p, "key", p)) for p in path_entries)
             return jax.device_put(
-                leaf, NamedSharding(mesh, spec_for(path, leaf.ndim)))
+                leaf, NamedSharding(mesh, spec_for(path)))
 
         return jax.tree_util.tree_map_with_path(put, params)
 

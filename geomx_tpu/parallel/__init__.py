@@ -18,4 +18,4 @@ from geomx_tpu.parallel.train_step import (  # noqa: F401
     DataParallelTrainer,
     HierarchicalTrainer,
 )
-from geomx_tpu.parallel.ring_attention import ring_attention  # noqa: F401
+from geomx_tpu.parallel.ring_attention import make_ring_attention  # noqa: F401

@@ -324,7 +324,11 @@ def read_frame(sock) -> Optional[bytes]:
 
 
 def _read_exact(sock, n: int) -> Optional[bytes]:
-    """Receive exactly n bytes into a single pre-allocated buffer."""
+    """Receive exactly n bytes into a single pre-allocated buffer.
+
+    Returns the bytearray itself (no final copy); downstream consumers
+    (struct.unpack, .decode, np.frombuffer) all accept buffer objects.
+    """
     buf = bytearray(n)
     view = memoryview(buf)
     got = 0
@@ -336,4 +340,4 @@ def _read_exact(sock, n: int) -> Optional[bytes]:
         if r == 0:
             return None
         got += r
-    return bytes(buf)
+    return buf

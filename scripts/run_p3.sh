@@ -1,0 +1,7 @@
+#!/bin/bash
+# HiPS demo with P3 priority-based parameter propagation enabled
+# (reference: scripts/cpu/run_p3.sh — ENABLE_P3=1 on every node).
+cd "$(dirname "$0")"
+export ENABLE_P3=1
+source ./hips_env.sh
+launch_hips "$REPO_DIR/examples/cnn.py" --cpu "$@"

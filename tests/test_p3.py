@@ -1,0 +1,150 @@
+"""P3 priority-propagation tests (reference: P3_EncodeDefaultKey,
+kvstore_dist.h:768-805 + the priority send thread, van.cc:548,851)."""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from geomx_tpu.config import Config
+from geomx_tpu.kvstore import sharding
+from geomx_tpu.kvstore.dist import KVStoreDist
+from geomx_tpu.kvstore.server import KVStoreDistServer
+from geomx_tpu.optimizer import SGD
+from geomx_tpu.ps import base as psbase
+from geomx_tpu.ps.message import Role
+from geomx_tpu.ps.postoffice import Postoffice
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_assign_p3_covers_and_round_robins():
+    shards = sharding.assign_p3(3, 100, 4, 16)
+    assert sum(s.length for s in shards) == 100
+    offs = [s.offset for s in shards]
+    assert offs == sorted(offs)
+    assert all(s.length <= 16 for s in shards)
+    # round-robin over servers starting at the hash server
+    start = (3 * 9973) % 4
+    for i, s in enumerate(shards):
+        assert s.server_rank == (start + i) % 4
+    # contiguous coverage
+    pos = 0
+    for s in shards:
+        assert s.offset == pos
+        pos += s.length
+    # zero-size keys still get one shard
+    z = sharding.assign_p3(1, 0, 4, 16)
+    assert len(z) == 1 and z[0].length == 0
+
+
+def test_assign_p3_small_key_single_slice():
+    shards = sharding.assign_p3(7, 10, 4, 16)
+    assert len(shards) == 1
+    assert shards[0].server_rank == (7 * 9973) % 4
+    assert shards[0].length == 10
+
+
+def _parallel(fns):
+    errs = []
+
+    def wrap(fn):
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=wrap, args=(fn,), daemon=True) for fn in fns]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(120)
+    if errs:
+        raise errs[0]
+
+
+def test_p3_single_tier_push_pull():
+    """Single-tier PS with ENABLE_P3: keys sliced at bigarray granularity,
+    per-slice messages through the priority queue; results must be exact."""
+    port = free_port()
+    threads = []
+    errors = []
+
+    def run(fn):
+        def w():
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+        t = threading.Thread(target=w, daemon=True)
+        t.start()
+        threads.append(t)
+
+    def mkcfg(role):
+        return Config(role=role, ps_root_uri="127.0.0.1", ps_root_port=port,
+                      num_workers=2, num_servers=1, enable_p3=True,
+                      bigarray_bound=16)
+
+    sched_po = Postoffice(my_role=Role.SCHEDULER, is_global=False,
+                          root_uri="127.0.0.1", root_port=port,
+                          num_workers=2, num_servers=1, cfg=Config())
+
+    def sched():
+        sched_po.start(60)
+        sched_po.barrier(psbase.ALL_GROUP, timeout=60)
+        sched_po.barrier(psbase.ALL_GROUP, timeout=120)
+        sched_po.van.stop()
+
+    run(sched)
+    srv = KVStoreDistServer(mkcfg("server"))
+    run(srv.run)
+    boxes = [[], []]
+    for i in range(2):
+        run(lambda b=boxes[i]: b.append(KVStoreDist(cfg=mkcfg("worker"))))
+    for _ in range(300):
+        if errors:
+            raise errors[0]
+        if all(len(b) == 1 for b in boxes):
+            break
+        threading.Event().wait(0.1)
+    kvs = [b[0] for b in boxes]
+    try:
+        rank0 = next(kv for kv in kvs if kv.rank == 0)
+        rank0.set_optimizer(SGD(learning_rate=0.5))
+        # key 0 is big (sliced into 3 slices of <=16), key 1 small
+        w = {0: np.arange(40, dtype=np.float32), 1: np.ones(8, np.float32)}
+        _parallel([lambda kv=kv: [kv.init(k, v) for k, v in w.items()]
+                   for kv in kvs])
+
+        def train(kv):
+            # later keys get higher priority (reference: push(idx, g,
+            # priority=-idx) in examples/cnn.py:123)
+            for k in w:
+                kv.push(k, np.ones_like(w[k]), priority=-k)
+            outs = {k: np.zeros_like(w[k]) for k in w}
+            for k in w:
+                kv.pull(k, out=outs[k], priority=-k)
+            kv.wait()
+            for k in w:
+                np.testing.assert_allclose(outs[k], w[k] - 1.0)  # 0.5*2 workers
+
+        _parallel([lambda kv=kv: train(kv) for kv in kvs])
+    finally:
+        _parallel([kv.close for kv in kvs])
+        for t in threads:
+            t.join(30)
+        if errors:
+            raise errors[0]
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))
