@@ -88,6 +88,7 @@ class Config:
     dmlc_k: float = 0.8                 # DMLC_K (fraction of blocks sent reliably)
     dmlc_k_min: float = 0.2             # DMLC_K_MIN
     adaptive_k_flag: bool = False       # ADAPTIVE_K_FLAG
+    dgt_grace_ms: int = 100             # DGT_GRACE_MS (straggler window, ours)
     enable_intra_ts: bool = False       # ENABLE_INTRA_TS
     enable_inter_ts: bool = False       # ENABLE_INTER_TS
     max_greed_rate_ts: float = 0.9      # MAX_GREED_RATE_TS
@@ -167,6 +168,7 @@ def load() -> Config:
         dmlc_k=env_float("DMLC_K", 0.8),
         dmlc_k_min=env_float("DMLC_K_MIN", 0.2),
         adaptive_k_flag=env_bool("ADAPTIVE_K_FLAG"),
+        dgt_grace_ms=env_int("DGT_GRACE_MS", 100),
         enable_intra_ts=env_bool("ENABLE_INTRA_TS"),
         enable_inter_ts=env_bool("ENABLE_INTER_TS"),
         max_greed_rate_ts=env_float("MAX_GREED_RATE_TS", 0.9),

@@ -49,23 +49,26 @@ def assign_p3(key: int, num_elems: int, num_servers: int,
               slice_bound: int) -> List[Shard]:
     """P3 slicing (reference: P3_EncodeDefaultKey, kvstore_dist.h:768-805).
 
-    Every key — regardless of size — is cut into slices of at most
-    ``slice_bound`` elements, assigned round-robin over servers starting at
-    the key's hash server. Each slice travels as its own message, so the
+    Each canonical shard (from :func:`assign`, so server placement agrees
+    with the server-side canonical ranges) is cut into slices of at most
+    ``slice_bound`` elements. Each slice travels as its own message, so the
     worker van's priority send queue can let a later (higher-priority,
     needed-sooner-on-the-next-forward) layer's small slices overtake an
     earlier layer's bulk — the essence of P3's slicing + priority
-    scheduling.
+    scheduling. (The reference round-robins slices over servers because its
+    wire-key encoding makes every slice its own key; our servers validate
+    explicit offsets against canonical ranges, so slices must stay inside
+    their canonical shard.)
     """
-    n = max(num_servers, 1)
-    start = (key * 9973) % n
     bound = max(slice_bound, 1)
-    shards = []
-    off = 0
-    i = 0
-    while off < num_elems or not shards:
-        ln = min(bound, num_elems - off)
-        shards.append(Shard((start + i) % n, off, ln, num_elems))
-        off += ln
-        i += 1
+    shards: List[Shard] = []
+    for base_shard in assign(key, num_elems, num_servers, slice_bound):
+        off = base_shard.offset
+        end = base_shard.offset + base_shard.length
+        while off < end or (off == end and base_shard.length == 0):
+            ln = min(bound, end - off)
+            shards.append(Shard(base_shard.server_rank, off, ln, num_elems))
+            off += ln
+            if base_shard.length == 0:
+                break
     return shards

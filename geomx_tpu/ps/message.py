@@ -72,9 +72,12 @@ class Node:
     port: int = 0
     is_recovery: bool = False
     customer_id: int = 0
+    # DGT lossy channels: UDP ports this node listens on (reference:
+    # van.cc:622-646 Bind_UDP + node table broadcast)
+    udp_ports: List[int] = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        d = {
             "role": int(self.role),
             "id": self.id,
             "hostname": self.hostname,
@@ -82,6 +85,9 @@ class Node:
             "is_recovery": self.is_recovery,
             "customer_id": self.customer_id,
         }
+        if self.udp_ports:
+            d["udp_ports"] = list(self.udp_ports)
+        return d
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "Node":
@@ -92,6 +98,7 @@ class Node:
             port=int(d.get("port", 0)),
             is_recovery=bool(d.get("is_recovery", False)),
             customer_id=int(d.get("customer_id", 0)),
+            udp_ports=[int(p) for p in d.get("udp_ports", [])],
         )
 
 
@@ -144,6 +151,13 @@ class Meta:
     total_bytes: int = 0
     channel: int = 0
     tos: int = 0
+    # DGT extras (ours): dtype of the split value buffer; 4-bit quantize
+    # scale and element count for "dgt4"-tagged blocks; lossy=True when the
+    # group's unimportant blocks ride UDP (gates receiver zero-fill)
+    val_dtype: str = ""
+    dgt_scale: float = 0.0
+    dgt_n: int = 0
+    lossy: bool = False
 
     # TSEngine bookkeeping
     num_merge: int = 1

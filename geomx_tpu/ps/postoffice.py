@@ -54,6 +54,18 @@ class Postoffice:
             heartbeat_timeout_s=cfg.heartbeat_timeout_s,
             use_priority_send=cfg.enable_p3 and my_role == Role.WORKER,
             verbose=cfg.verbose,
+            # DGT runs on the inter-DC (global) tier only (reference:
+            # StartGlobal binds the UDP channels, van.cc:613-646)
+            dgt={
+                "mode": cfg.enable_dgt,
+                "channels": cfg.udp_channel_num or 1,
+                "block_size": cfg.dgt_block_size,
+                "alpha": cfg.dgt_contri_alpha,
+                "k": cfg.dmlc_k,
+                "k_min": cfg.dmlc_k_min,
+                "adaptive": cfg.adaptive_k_flag,
+                "grace_s": cfg.dgt_grace_ms / 1000.0,
+            } if (is_global and cfg.enable_dgt) else None,
         )
         self.van.msg_handler = self._dispatch
         self._customers: Dict[Tuple[int, int], Customer] = {}

@@ -33,6 +33,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from geomx_tpu.ps import base
+from geomx_tpu.ps import dgt as dgt_mod
 from geomx_tpu.ps.message import (Control, Message, Meta, Node, Role,
                                   read_message)
 
@@ -57,6 +58,7 @@ class Van:
         heartbeat_timeout_s: float = 60.0,
         use_priority_send: bool = False,
         verbose: int = 0,
+        dgt: Optional[dict] = None,
     ):
         self.my_role = my_role
         self.is_global = is_global
@@ -101,6 +103,20 @@ class Van:
         # called on the scheduler when the topology is (re)broadcast
         self.on_node_update: Optional[Callable[[List[Node]], None]] = None
 
+        # DGT (reference: van.cc:613-646): only meaningful on the global
+        # tier's van; ``dgt`` holds {mode, channels, block_size, alpha, k,
+        # k_min, adaptive}
+        self._dgt_cfg = dgt if dgt and dgt.get("mode", 0) else None
+        self._dgt_sender: Optional[dgt_mod.DGTSender] = None
+        self._dgt_queues: Optional[dgt_mod.DGTQueues] = None
+        self._dgt_reasm = dgt_mod.DGTReassembler(
+            grace_s=(dgt or {}).get("grace_s", 0.1), deliver=self._process)
+        self._udp_socks: List[socket.socket] = []
+        self.udp_ports: List[int] = []
+        # id -> [udp ports] learned from the node table
+        self._node_udp: Dict[int, List[int]] = {}
+        self._udp_send_sock: Optional[socket.socket] = None
+
         self._listener: Optional[socket.socket] = None
         self._threads: List[threading.Thread] = []
         self._send_queue: List[Tuple[int, int, Message]] = []
@@ -116,6 +132,8 @@ class Van:
     def start(self, timeout: float = 60.0) -> None:
         self._bind()
         self._spawn(self._accept_loop, "van-accept")
+        if self._dgt_cfg is not None:
+            self._start_dgt()
         if self.use_priority_send:
             self._spawn(self._priority_send_loop, "van-psend")
         if self.is_scheduler:
@@ -138,6 +156,18 @@ class Van:
         self.stopped.set()
         with self._send_cv:
             self._send_cv.notify_all()
+        if self._dgt_queues is not None:
+            self._dgt_queues.stop()
+        for s in self._udp_socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        if self._udp_send_sock is not None:
+            try:
+                self._udp_send_sock.close()
+            except OSError:
+                pass
         if self._listener is not None:
             try:
                 self._listener.close()
@@ -162,12 +192,64 @@ class Van:
         self._listener = s
         self.my_port = s.getsockname()[1]
 
+    def _start_dgt(self) -> None:
+        """Bind UDP channels + spawn schedulers (reference: van.cc:613-646)."""
+        c = self._dgt_cfg
+        mode = c["mode"]
+        nch = max(c.get("channels", 1), 1)
+        if mode == 1:
+            for _ in range(nch):
+                s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                s.bind((self.bind_host, 0))
+                self._udp_socks.append(s)
+                self.udp_ports.append(s.getsockname()[1])
+                self._spawn(self._udp_reader_loop, "van-udp", s)
+            self._udp_send_sock = socket.socket(socket.AF_INET,
+                                                socket.SOCK_DGRAM)
+        self._dgt_sender = dgt_mod.DGTSender(
+            mode=mode, num_channels=nch,
+            block_size=c.get("block_size", 4096),
+            contri_alpha=c.get("alpha", 0.3),
+            k=c.get("k", 0.8), k_min=c.get("k_min", 0.2),
+            adaptive_k=c.get("adaptive", False))
+        self._dgt_queues = dgt_mod.DGTQueues(
+            send_fn=lambda t, m: self._send_one(t, m),
+            send_udp_fn=self._send_udp, mode=mode)
+
+    def _send_udp(self, channel: int, target: int, msg: Message) -> None:
+        ports = self._node_udp.get(target)
+        addr = self.node_table.get(target)
+        if not ports or addr is None or self._udp_send_sock is None:
+            # peer has no UDP channels (or table not ready): fall back TCP
+            self._send_one(target, msg)
+            return
+        port = ports[(channel - 1) % len(ports)]
+        buf = msg.pack()
+        self._udp_send_sock.sendto(buf, (addr[0], port))
+        self.send_bytes += len(buf)
+
+    def _udp_reader_loop(self, sock: socket.socket) -> None:
+        while not self.stopped.is_set():
+            try:
+                data, _addr = sock.recvfrom(65535)
+            except OSError:
+                return
+            self.recv_bytes += len(data)
+            try:
+                msg = Message.unpack(data)
+                if self.drop_rate > 0 and random.random() < self.drop_rate:
+                    continue
+                self._process(msg)
+            except Exception:
+                log.exception("error processing UDP datagram; reader kept")
+
     def _register(self, timeout: float) -> None:
         """Send ADD_NODE to the scheduler (reference: van.cc:509-516)."""
         node = Node(
             role=self.my_role,
             hostname=self.bind_host,
             port=self.my_port,
+            udp_ports=list(self.udp_ports),
         )
         msg = Message(
             Meta(
@@ -213,6 +295,14 @@ class Van:
                 self._process(self._reframe(msg, t))
                 continue
             m = self._reframe(msg, t)
+            if (self._dgt_sender is not None and not m.is_control
+                    and self._dgt_sender.applicable(m)):
+                # DGT: split into channelized blocks (reference: TS_Send,
+                # kv_app.h:1146-1205)
+                for ch, bmsg in self._dgt_sender.split(m):
+                    total += len(bmsg.data[-1]) if bmsg.data else 0
+                    self._dgt_queues.put(ch, t, bmsg)
+                continue
             if self.use_priority_send and not m.is_control:
                 with self._send_cv:
                     heapq.heappush(
@@ -362,6 +452,13 @@ class Van:
             self._heartbeats[msg.meta.sender] = time.monotonic()
         elif cmd == Control.TERMINATE:
             self.stopped.set()
+        elif msg.meta.msg_type in (dgt_mod.MSG_TYPE_BLOCK,
+                                   dgt_mod.MSG_TYPE_TAIL):
+            # DGT block: reassemble; a completed group re-enters as a
+            # normal data message (reference: ProcessDataMsg van.cc:330-370)
+            whole = self._dgt_reasm.accept(msg)
+            if whole is not None:
+                self._process(whole)
         else:
             handler = self.msg_handler
             if handler is not None:
@@ -384,6 +481,8 @@ class Van:
                     self._evict_conn(n.id)
                 self.node_table[n.id] = (n.hostname, n.port)
                 self.node_roles[n.id] = n.role
+                if n.udp_ports:
+                    self._node_udp[n.id] = list(n.udp_ports)
                 if (
                     n.hostname == self.bind_host
                     and n.port == self.my_port
@@ -444,6 +543,8 @@ class Van:
                     self._evict_conn(n.id)
                 self.node_table[n.id] = (n.hostname, n.port)
                 self.node_roles[n.id] = n.role
+                if n.udp_ports:
+                    self._node_udp[n.id] = list(n.udp_ports)
                 # a fresh registration counts as a liveness signal so
                 # dead-node detection starts from "alive", not "unknown"
                 self._heartbeats[n.id] = time.monotonic()

@@ -1,7 +1,6 @@
 """P3 priority-propagation tests (reference: P3_EncodeDefaultKey,
 kvstore_dist.h:768-805 + the priority send thread, van.cc:548,851)."""
 
-import socket
 import threading
 
 import numpy as np
@@ -15,31 +14,28 @@ from geomx_tpu.optimizer import SGD
 from geomx_tpu.ps import base as psbase
 from geomx_tpu.ps.message import Role
 from geomx_tpu.ps.postoffice import Postoffice
+from tests.test_hips import _parallel, free_port  # shared scaffolding
 
 
-def free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def test_assign_p3_covers_and_round_robins():
+def test_assign_p3_covers_and_respects_canonical_ranges():
     shards = sharding.assign_p3(3, 100, 4, 16)
     assert sum(s.length for s in shards) == 100
     offs = [s.offset for s in shards]
     assert offs == sorted(offs)
     assert all(s.length <= 16 for s in shards)
-    # round-robin over servers starting at the hash server
-    start = (3 * 9973) % 4
-    for i, s in enumerate(shards):
-        assert s.server_rank == (start + i) % 4
     # contiguous coverage
     pos = 0
     for s in shards:
         assert s.offset == pos
         pos += s.length
+    # every slice lies INSIDE its server's canonical assign() range — the
+    # global-store server validates offsets against these (server.py
+    # _canonical_ranges), so P3 slicing must not re-route across servers
+    canon = {c.server_rank: c for c in sharding.assign(3, 100, 4, 16)}
+    for s in shards:
+        c = canon[s.server_rank]
+        assert c.offset <= s.offset
+        assert s.offset + s.length <= c.offset + c.length
     # zero-size keys still get one shard
     z = sharding.assign_p3(1, 0, 4, 16)
     assert len(z) == 1 and z[0].length == 0
@@ -50,24 +46,6 @@ def test_assign_p3_small_key_single_slice():
     assert len(shards) == 1
     assert shards[0].server_rank == (7 * 9973) % 4
     assert shards[0].length == 10
-
-
-def _parallel(fns):
-    errs = []
-
-    def wrap(fn):
-        try:
-            fn()
-        except BaseException as e:  # noqa: BLE001
-            errs.append(e)
-
-    ts = [threading.Thread(target=wrap, args=(fn,), daemon=True) for fn in fns]
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join(120)
-    if errs:
-        raise errs[0]
 
 
 def test_p3_single_tier_push_pull():
