@@ -71,6 +71,21 @@ class KVStoreDist(KVStore):
         self.po.start()
         self.kvw = KVWorker(self.po)
 
+        # TSEngine (reference: ENABLE_INTRA_TS, kv_app.h:110): gradients
+        # merge worker-to-worker along a scheduler-built overlay; models
+        # come back via relay + auto_pull instead of server pulls
+        self._ts = None
+        self._ts_ver: Dict[int, int] = {}
+        if c.enable_intra_ts:
+            from geomx_tpu.ps.tsengine import TSNode
+
+            self._ts = TSNode(self.po, self.kvw,
+                              tgt_merge=self.po.num_workers,
+                              final_push=self._ts_final_push)
+            self._ts.on_push_sent = lambda _k, _o, _v: self._untrack()
+            self.kvw.set_request_handle(
+                lambda req, kvs, app: self._ts.handle_request(req, kvs, app))
+
         self._key_info: Dict[int, _KeyInfo] = {}
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -177,6 +192,13 @@ class KVStoreDist(KVStore):
             merged = _sum_values(v)
             info = self._info(k, merged)
             flat = np.ascontiguousarray(merged).ravel()
+            if self._ts is not None:
+                # TSEngine: contribute to the reduction overlay; the last
+                # holder pushes the merged gradient for everyone
+                ver = self._ts_ver[k] = self._ts_ver.get(k, 0) + 1
+                self._track(1)
+                self._ts.contribute(k, 0, info.total, flat, ver)
+                continue
             with self._lock:
                 self._push_acks_left[k] = (
                     self._push_acks_left.get(k, 0) + len(info.shards))
@@ -188,6 +210,30 @@ class KVStoreDist(KVStore):
                               lens=[sh.length])
                 self.kvw.push(kvs, sh.server_rank, priority=priority,
                               cb=lambda _ts, kk=k: self._on_push_ack(kk))
+
+    def _ts_final_push(self, key: int, off: int, total: int,
+                       arr: np.ndarray, num_merge: int, ver: int) -> None:
+        """The last overlay holder pushes the merged gradient to the
+        server tier with ``num_merge`` contributions (reference: the
+        terminal TS hop, kvstore_dist.h:97-121 + server counting at
+        kvstore_dist_server.h:1301)."""
+        info = self._key_info[key]
+        remaining = [len(info.shards)]
+
+        def on_ack(_ts):
+            with self._lock:
+                remaining[0] -= 1
+                last = remaining[0] == 0
+            if last:
+                self._untrack()
+
+        for sh in info.shards:
+            kvs = KVPairs(keys=[key],
+                          vals=[arr[sh.offset:sh.offset + sh.length]],
+                          offsets=[sh.offset], totals=[sh.total],
+                          lens=[sh.length])
+            self.kvw.push(kvs, sh.server_rank, num_merge=num_merge,
+                          cb=on_ack)
 
     def _on_push_ack(self, key: int) -> None:
         ready = []
@@ -215,6 +261,21 @@ class KVStoreDist(KVStore):
     def _pull_one(self, key: int, out, priority: int):
         info = self._key_info.get(key)
         assert info is not None, f"pull of key {key} before init"
+        if self._ts is not None and self._ts_ver.get(key, 0) > 0:
+            # TSEngine: gather the disseminated model (AutoPull,
+            # kv_app.h:1694) — blocking by design; before the first push
+            # (initial broadcast) the normal pull path below still runs
+            ver = self._ts_ver[key]
+            buf = np.zeros(info.total, dtype=np.float32)
+            for sh in info.shards:
+                part = self._ts.auto_pull(key, sh.offset, ver)
+                n = min(part.size, sh.length)
+                buf[sh.offset:sh.offset + n] = part[:n]
+            result = buf.reshape(info.shape).astype(info.dtype, copy=False)
+            if out is not None:
+                np.copyto(out, result)
+                return None
+            return result
         if out is not None and not (isinstance(out, np.ndarray)
                                     and out.flags.writeable):
             raise TypeError(

@@ -167,6 +167,14 @@ class KVStoreDistServer:
         self.server_global: Optional[KVServer] = None
         self.worker_global: Optional[KVWorker] = None
 
+        # TSEngine endpoints (reference: ENABLE_INTRA_TS / ENABLE_INTER_TS)
+        self.ts_local = None     # model dissemination to local workers
+        self.ts_global = None    # global-tier overlay (party/global server)
+        self._ts_kvw_local: Optional[KVWorker] = None
+        self._ts_kvw_global: Optional[KVWorker] = None
+        # party-server: per (key, slice-offset) global round counter
+        self._g_rounds: Dict[Tuple[int, int], int] = {}
+
     # ------------------------------------------------------------------
     # lifecycle (reference: kvstore_dist.h:237-258 RunServer)
     # ------------------------------------------------------------------
@@ -176,6 +184,15 @@ class KVStoreDistServer:
         self.server_local = KVServer(self.po_local)
         self.server_local.set_request_handle(
             lambda req, kvs, srv: self._handle(req, kvs, srv, global_tier=False))
+        if self.cfg.enable_intra_ts:
+            # model dissemination to this party's workers (reference:
+            # DefaultAutoPull, kvstore_dist_server.h:1372); a dedicated
+            # KVWorker (customer_id=1) carries the model hops
+            from geomx_tpu.ps.tsengine import TSNode
+
+            self._ts_kvw_local = KVWorker(self.po_local, customer_id=1)
+            self.ts_local = TSNode(self.po_local, self._ts_kvw_local,
+                                   tgt_merge=self.po_local.num_workers)
         # startup barrier, local tier (reference: kvstore_dist.h:246)
         self.po_local.barrier(psbase.ALL_GROUP, timeout=600.0)
         if self.po_global is not None:
@@ -185,13 +202,36 @@ class KVStoreDistServer:
                 self.server_global.set_request_handle(
                     lambda req, kvs, srv: self._handle(req, kvs, srv,
                                                        global_tier=True))
+                if self.cfg.enable_inter_ts:
+                    from geomx_tpu.ps.tsengine import TSNode
+
+                    self._ts_kvw_global = KVWorker(self.po_global,
+                                                   customer_id=1)
+                    self.ts_global = TSNode(
+                        self.po_global, self._ts_kvw_global,
+                        tgt_merge=self._num_parties())
             else:
                 self.worker_global = KVWorker(self.po_global)
-                # config commands re-broadcast by the global server arrive on
-                # the global overlay (reference: kvstore_dist_server.h:311-318)
-                self.worker_global.set_request_handle(
-                    lambda req, kvs, srv: self._handle(req, kvs, srv,
-                                                       global_tier=True))
+                if self.cfg.enable_inter_ts:
+                    from geomx_tpu.ps.tsengine import TSNode
+
+                    self.ts_global = TSNode(
+                        self.po_global, self.worker_global,
+                        tgt_merge=self._num_parties(),
+                        final_push=self._ts_global_final_push)
+                    # TS relay/model hops first; everything else falls
+                    # through to the command handler
+                    self.worker_global.set_request_handle(
+                        lambda req, kvs, srv:
+                        self.ts_global.handle_request(req, kvs, srv)
+                        or self._handle(req, kvs, srv, global_tier=True))
+                else:
+                    # config commands re-broadcast by the global server
+                    # arrive on the global overlay (reference:
+                    # kvstore_dist_server.h:311-318)
+                    self.worker_global.set_request_handle(
+                        lambda req, kvs, srv: self._handle(req, kvs, srv,
+                                                           global_tier=True))
         if self.po_global is not None:
             # startup barrier, global tier (reference: kvstore_dist.h:249-251)
             self.po_global.barrier(psbase.ALL_GROUP, timeout=600.0)
@@ -295,15 +335,19 @@ class KVStoreDistServer:
             st.stored = np.asarray(new_w, dtype=st.dtype).ravel()
             st.initialized = True
             st.version += 1
-            return ([lambda r=r, s=s: s.response(r) for r, s in reqs]
-                    + self._flush_pulls(st, key))
+            return ([lambda r=r, s=s: s.response(r)
+                     for r, s in self._uniq(reqs)]
+                    + self._flush_pulls(st, key)
+                    + self._offer_local(st, key))
 
         if self.use_hfa and st.rounds % self.period_k2 != 0:
             # HFA local round: store the averaged weights, ack immediately
             # (reference: :1327-1333)
             st.stored = st.merged.astype(st.dtype)
             st.version += 1
-            return [lambda r=r, s=s: s.response(r) for r, s in reqs]
+            return ([lambda r=r, s=s: s.response(r)
+                     for r, s in self._uniq(reqs)]
+                    + self._offer_local(st, key))
 
         if self.use_hfa:
             # milestone delta (reference: :1334-1338)
@@ -406,7 +450,9 @@ class KVStoreDistServer:
             st.merged = np.zeros(st.length, dtype=np.float32)
             st.elems_received = 0
         st.merged[lo - rng.offset:lo - rng.offset + sub.size] += sub
-        st.elems_received += sub.size
+        # TSEngine final hops carry num_merge parties' worth of gradient in
+        # one push (reference counting: kvstore_dist_server.h:1301)
+        st.elems_received += sub.size * max(req.num_merge, 1)
         st.push_reqs.append((req, srv))
         if from_global_tier:
             self._party_nsrv = max(req.party_nsrv, 1)
@@ -427,8 +473,16 @@ class KVStoreDistServer:
         st.elems_received = 0
         st.version += 1
         reqs, st.push_reqs = st.push_reqs, []
-        return ([lambda r=r, s=s: s.response(r) for r, s in reqs]
+        acts = ([lambda r=r, s=s: s.response(r) for r, s in self._uniq(reqs)]
                 + self._flush_pulls(st, key))
+        if self.ts_global is not None and st.rounds > 0:
+            # inter-TS: disseminate fresh params through the overlay
+            # instead of waiting for party pulls (AutoPullUpdate1/2,
+            # kv_app.h:549-659)
+            data, total, o, v = st.stored.copy(), st.total, rng.offset, st.rounds
+            acts.append(lambda: self.ts_global.offer_model(key, o, total,
+                                                           data, v))
+        return acts
 
 
     # ------------------------------------------------------------------
@@ -510,6 +564,9 @@ class KVStoreDistServer:
     # ------------------------------------------------------------------
 
     def _forward_to_global(self, key: int, off: int) -> None:
+        if self.ts_global is not None and self.sync_global_mode:
+            self._ts_forward_to_global(key, off)
+            return
         with self._lock:
             st = self._state(key, off)
             payload = st.stored
@@ -525,6 +582,103 @@ class KVStoreDistServer:
             self.worker_global.push(
                 kvs, g_rank, party_nsrv=self.po_local.num_servers,
                 cb=lambda _ts, k=key, o=off: self._on_global_push_ack(k, o))
+
+    def _ts_forward_to_global(self, key: int, off: int) -> None:
+        """Inter-TS: contribute each global slice to the overlay (merged
+        party-to-party), watch for the disseminated model (reference: the
+        TS_Push / AutoPull2 path)."""
+        from geomx_tpu.kvstore import sharding as _sh
+
+        with self._lock:
+            st = self._state(key, off)
+            payload = st.stored
+            total = st.total
+            length = st.length
+            ranges = _sh.assign(key, total, self.po_global.num_servers,
+                                self.cfg.bigarray_bound)
+            overlaps = []
+            for rng in ranges:
+                lo = max(off, rng.offset)
+                hi = min(off + length, rng.offset + rng.length)
+                if lo < hi:
+                    overlaps.append((rng, lo, hi))
+            v = self._g_rounds[(key, off)] = self._g_rounds.get((key, off),
+                                                               0) + 1
+            st.fwd_expected = len(overlaps)
+            st.fwd_parts = {}
+        for rng, lo, hi in overlaps:
+            sub = np.ascontiguousarray(payload[lo - off:hi - off])
+            # the model comes back as the WHOLE canonical range, relayed to
+            # every global worker — watch the range offset, extract overlap
+            self.ts_global.when_model(
+                key, rng.offset, v,
+                lambda k=key, o=off, ro=rng.offset, l=lo, h=hi:
+                    self._on_ts_global_model(k, o, ro, l, h))
+            self.ts_global.contribute(key, lo, total, sub, v)
+
+    def _on_ts_global_model(self, key, off, rng_off, lo, hi) -> None:
+        data = self.ts_global.model_of(key, rng_off)
+        acts: List[Action] = []
+        with self._lock:
+            st = self._state(key, off)
+            if data is not None:
+                hi2 = min(hi, rng_off + data.size)
+                if hi2 > lo:
+                    st.fwd_parts[lo] = data[lo - rng_off:hi2 - rng_off]
+            if st.fwd_expected > 0 and len(st.fwd_parts) >= st.fwd_expected:
+                acts = self._complete_global_round(st, key)
+        for fn in acts:
+            fn()
+
+    def _ts_global_final_push(self, key: int, off: int, total: int,
+                              arr: np.ndarray, num_merge: int,
+                              ver: int) -> None:
+        """Terminal inter-TS hop: deliver the party-merged aggregate slice
+        to the global server that owns it."""
+        from geomx_tpu.kvstore import sharding as _sh
+
+        for rng in _sh.assign(key, total, self.po_global.num_servers,
+                              self.cfg.bigarray_bound):
+            lo = max(off, rng.offset)
+            hi = min(off + arr.size, rng.offset + rng.length)
+            if lo >= hi:
+                continue
+            sub = np.ascontiguousarray(arr[lo - off:hi - off])
+            # WAN compression still applies on the terminal WAN hop; the
+            # peer-to-peer relay hops and the model dissemination travel
+            # uncompressed (the reference TSEngine predates compression
+            # composition and does the same)
+            wire_val, aux, compr = self.gc.compress_push(sub, (key, lo))
+            kvs = KVPairs(keys=[key], vals=[wire_val], aux=[aux],
+                          offsets=[lo], totals=[total], lens=[hi - lo],
+                          compr=compr)
+            self.worker_global.push(
+                kvs, rng.server_rank, num_merge=num_merge,
+                party_nsrv=self.po_local.num_servers,
+                cb=lambda _ts: None)
+
+    def _num_parties(self) -> int:
+        if self.po_global is None:
+            return 1
+        spp = max(self.po_local.num_servers, 1)
+        return max(self.po_global.num_workers // spp, 1)
+
+    @staticmethod
+    def _uniq(reqs):
+        """Collapse duplicated (req, srv) ack entries: a TSEngine final
+        push appears ``num_merge`` times in the round's request list but
+        must be acked exactly once."""
+        seen = {}
+        for r, s in reqs:
+            seen[(r.sender, r.timestamp)] = (r, s)
+        return list(seen.values())
+
+    def _offer_local(self, st: "_KeyState", key: int) -> List[Action]:
+        """Start intra-TS model dissemination for a completed round."""
+        if self.ts_local is None or st.rounds <= 0:
+            return []
+        data, total, o, v = st.stored.copy(), st.total, st.offset, st.rounds
+        return [lambda: self.ts_local.offer_model(key, o, total, data, v)]
 
     def _global_slices(self, key, off, length, total):
         """Overlaps of this server's shard with global canonical ranges."""
@@ -605,8 +759,10 @@ class KVStoreDistServer:
         st.initialized = True
         st.version += 1
         acks, st.deferred_acks = st.deferred_acks, []
-        acts: List[Action] = [lambda r=r, s=s: s.response(r) for r, s in acks]
+        acts: List[Action] = [lambda r=r, s=s: s.response(r)
+                              for r, s in self._uniq(acks)]
         acts += self._flush_pulls(st, key)
+        acts += self._offer_local(st, key)
         return acts
 
     # ------------------------------------------------------------------

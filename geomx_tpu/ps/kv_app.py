@@ -157,8 +157,9 @@ class KVWorker:
     def push(
         self,
         kvs: KVPairs,
-        server_rank: int,
+        server_rank: int = -1,
         *,
+        recver_id: Optional[int] = None,
         cmd: int = 0,
         priority: int = 0,
         version: int = 0,
@@ -168,13 +169,19 @@ class KVWorker:
         pull: bool = False,
         cb: Optional[Callable[[int], None]] = None,
     ) -> int:
-        """ZPush (reference: kv_app.h:219). Response = 1 server ack."""
+        """ZPush (reference: kv_app.h:219). Response = 1 ack.
+
+        Normally targets a server by rank; TSEngine relay hops pass an
+        explicit ``recver_id`` (peer worker) instead (reference:
+        TS relay sends in kv_app.h:234-246).
+        """
         ts = self.customer.new_request(1, auto_clear=cb is not None)
         if cb is not None:
             with self._lock:
                 self._callbacks[ts] = cb
         meta = Meta(
-            recver=base.server_rank_to_id(server_rank),
+            recver=(recver_id if recver_id is not None
+                    else base.server_rank_to_id(server_rank)),
             app_id=KV_APP_ID,
             customer_id=self.customer.customer_id,
             timestamp=ts,

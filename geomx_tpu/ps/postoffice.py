@@ -71,6 +71,16 @@ class Postoffice:
         self._customers: Dict[Tuple[int, int], Customer] = {}
         self._customers_lock = threading.Lock()
         self._started = False
+        # TSEngine: the scheduler of a TS-enabled tier runs the matchmaker
+        # (reference: van.cc:1197-1458); members attach a TSNode later
+        self.ts_scheduler = None
+        ts_on = cfg.enable_inter_ts if is_global else cfg.enable_intra_ts
+        if my_role == Role.SCHEDULER and ts_on:
+            from geomx_tpu.ps.tsengine import TSScheduler
+
+            self.ts_scheduler = TSScheduler(
+                self.van, num_workers, greed_rate=cfg.max_greed_rate_ts)
+            self.van.ts_handler = self.ts_scheduler.handle
 
     # -- lifecycle -------------------------------------------------------
 
@@ -163,6 +173,10 @@ class Postoffice:
             log.warning("no customer for app=%s cid=%s; dropping message", *key)
             return
         cust.accept(msg)
+
+    def attach_ts(self, node) -> None:
+        """Register a member-side TSNode to receive REPLY control traffic."""
+        self.van.ts_handler = node.on_control
 
     # -- barriers (reference: postoffice.h:167) --------------------------
 

@@ -100,6 +100,9 @@ class Van:
 
         # upward dispatch: set by Postoffice before start()
         self.msg_handler: Optional[Callable[[Message], None]] = None
+        # TSEngine control traffic (ASKPUSH/ASKPULL/REPLY): set by the
+        # Postoffice when TSEngine is enabled for this tier
+        self.ts_handler: Optional[Callable[[Message], None]] = None
         # called on the scheduler when the topology is (re)broadcast
         self.on_node_update: Optional[Callable[[List[Node]], None]] = None
 
@@ -452,6 +455,15 @@ class Van:
             self._heartbeats[msg.meta.sender] = time.monotonic()
         elif cmd == Control.TERMINATE:
             self.stopped.set()
+        elif cmd in (Control.ASKPUSH, Control.ASKPULL, Control.REPLY,
+                     Control.AUTOPULLREPLY):
+            # TSEngine matchmaking (reference: van.cc:1197-1458)
+            h = self.ts_handler
+            if h is not None:
+                h(msg)
+            else:
+                log.warning("TS control message but TSEngine not enabled "
+                            "on this node (cmd=%d)", cmd)
         elif msg.meta.msg_type in (dgt_mod.MSG_TYPE_BLOCK,
                                    dgt_mod.MSG_TYPE_TAIL):
             # DGT block: reassemble; a completed group re-enters as a

@@ -1,0 +1,9 @@
+#!/bin/bash
+# HiPS demo with inter-DC TSEngine: party-to-party aggregate merge and
+# global model relay on the WAN tier
+# (reference: scripts/cpu/run_inter_tsengine.sh — ENABLE_INTER_TS=1).
+cd "$(dirname "$0")"
+export ENABLE_INTER_TS=1
+export MAX_GREED_RATE_TS=${MAX_GREED_RATE_TS:-0.9}
+source ./hips_env.sh
+launch_hips "$REPO_DIR/examples/cnn.py" --cpu "$@"

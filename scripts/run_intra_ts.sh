@@ -1,0 +1,9 @@
+#!/bin/bash
+# HiPS demo with intra-DC TSEngine: worker-to-worker gradient merge and
+# model relay overlays built by the scheduler
+# (reference: scripts/cpu/run_intra_tsengine.sh — ENABLE_INTRA_TS=1).
+cd "$(dirname "$0")"
+export ENABLE_INTRA_TS=1
+export MAX_GREED_RATE_TS=${MAX_GREED_RATE_TS:-0.9}
+source ./hips_env.sh
+launch_hips "$REPO_DIR/examples/cnn.py" --cpu "$@"

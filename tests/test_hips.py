@@ -37,7 +37,8 @@ class Topology:
 
     def __init__(self, num_parties=2, workers_per_party=2, num_global_servers=1,
                  servers_per_party=1, use_hfa=False, hfa_k2=1,
-                 enable_central_worker=False, bigarray_bound=1000000):
+                 enable_central_worker=False, bigarray_bound=1000000,
+                 extra_cfg=None):
         self.gport = free_port()
         self.cports = [free_port() for _ in range(num_parties + 1)]  # [0]=central
         self.num_parties = num_parties
@@ -50,6 +51,7 @@ class Topology:
         self.use_hfa = use_hfa
         self.hfa_k2 = hfa_k2
         self.ecw = enable_central_worker
+        self.extra_cfg = dict(extra_cfg or {})
         self.threads: List[threading.Thread] = []
         self.servers: List[KVStoreDistServer] = []
         self.workers: List[KVStoreDist] = []
@@ -64,6 +66,7 @@ class Topology:
             hfa_k2=self.hfa_k2, enable_central_worker=self.ecw,
             bigarray_bound=self.bigarray_bound,
         )
+        base.update(self.extra_cfg)
         base.update(kw)
         return Config(**base)
 
@@ -83,7 +86,7 @@ class Topology:
         po = Postoffice(
             my_role=Role.SCHEDULER, is_global=is_global,
             root_uri="127.0.0.1", root_port=root_port,
-            num_workers=nw, num_servers=ns, cfg=Config(),
+            num_workers=nw, num_servers=ns, cfg=Config(**self.extra_cfg),
         )
         po.start(60.0)
         po.barrier(psbase.ALL_GROUP, timeout=60.0)    # startup round

@@ -1,0 +1,273 @@
+"""TSEngine tests: scheduler matchmaking unit tests + overlay integration.
+
+Covers the reference behaviors of ProcessAskPush/PullCommand (reference:
+3rdparty/ps-lite/src/van.cc:1197-1458), the worker merge relay
+(WorkersMerge, src/kvstore/kvstore_dist.h:91-121) and AutoPull model
+dissemination (include/ps/kv_app.h:549-659,1694) — re-implemented in
+geomx_tpu/ps/tsengine.py.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from geomx_tpu.config import Config
+from geomx_tpu.kvstore.dist import KVStoreDist
+from geomx_tpu.kvstore.server import KVStoreDistServer
+from geomx_tpu.optimizer import SGD
+from geomx_tpu.ps import base as psbase
+from geomx_tpu.ps.message import Control, Message, Meta, Role
+from geomx_tpu.ps.postoffice import Postoffice
+from geomx_tpu.ps.tsengine import DONE_DEST, SERVER_DEST, TSScheduler
+
+from test_hips import Topology, _parallel, free_port
+
+
+class FakeVan:
+    is_global = False
+
+    def __init__(self):
+        self.sent = []
+
+    def send(self, msg):
+        self.sent.append(msg)
+
+
+def _ask(sched, cmd, sender, **body):
+    msg = Message(Meta(control_cmd=cmd,
+                       body=json.dumps(body)))
+    msg.meta.sender = sender
+    sched.handle(msg)
+
+
+def _replies(van):
+    out = []
+    for m in van.sent:
+        d = json.loads(m.meta.body)
+        out.append((m.meta.recver, d))
+    van.sent.clear()
+    return out
+
+
+def test_scheduler_push_pairing_reduces_to_server():
+    """4 workers ask with nm=1: the scheduler pairs them into a reduction
+    tree; the final holder (nm=4) is told to push to the server."""
+    van = FakeVan()
+    sched = TSScheduler(van, num_workers=4, greed_rate=1.0)
+    w = [psbase.worker_rank_to_id(r) for r in range(4)]
+
+    for wid in w:
+        _ask(sched, Control.ASKPUSH, wid, key=0, off=0, ver=1, nm=1, tgt=4)
+    rep = _replies(van)
+    assert len(rep) == 2  # two pairs formed
+    senders = {to for to, _ in rep}
+    receivers = {d["dest"] for _, d in rep}
+    assert senders.isdisjoint(receivers)
+    assert all(d["kind"] == "push" for _, d in rep)
+
+    # the two receivers merged -> re-ask with nm=2
+    for r in receivers:
+        _ask(sched, Control.ASKPUSH, r, key=0, off=0, ver=1, nm=2, tgt=4)
+    rep = _replies(van)
+    assert len(rep) == 1
+    final_recv = rep[0][1]["dest"]
+
+    # final holder has everything -> push to server
+    _ask(sched, Control.ASKPUSH, final_recv, key=0, off=0, ver=1, nm=4, tgt=4)
+    rep = _replies(van)
+    assert rep == [(final_recv, {"kind": "push", "key": 0, "off": 0,
+                                 "ver": 1, "dest": SERVER_DEST})]
+
+
+def test_scheduler_pull_relay_serves_every_worker_once():
+    van = FakeVan()
+    sched = TSScheduler(van, num_workers=3, greed_rate=0.0)
+    server = psbase.server_rank_to_id(0)
+    served = set()
+
+    # the server keeps asking; each reply hands out a fresh worker
+    for _ in range(3):
+        _ask(sched, Control.ASKPULL, server, key=5, off=0, ver=2)
+        [(_, d)] = _replies(van)
+        assert d["dest"] not in served and d["dest"] != DONE_DEST
+        served.add(d["dest"])
+    assert len(served) == 3
+
+    _ask(sched, Control.ASKPULL, server, key=5, off=0, ver=2)
+    [(_, d)] = _replies(van)
+    assert d["dest"] == DONE_DEST
+
+
+def test_scheduler_pull_excludes_holder():
+    """A worker that already holds the model is never chosen to receive."""
+    van = FakeVan()
+    sched = TSScheduler(van, num_workers=2, greed_rate=1.0)
+    holder = psbase.worker_rank_to_id(0)
+    _ask(sched, Control.ASKPULL, holder, key=1, off=0, ver=1)
+    [(_, d)] = _replies(van)
+    assert d["dest"] == psbase.worker_rank_to_id(1)
+
+
+def test_scheduler_greedy_prefers_measured_throughput():
+    van = FakeVan()
+    sched = TSScheduler(van, num_workers=3, greed_rate=1.0)
+    server = psbase.server_rank_to_id(0)
+    w = [psbase.worker_rank_to_id(r) for r in range(3)]
+    # report: server->w2 is the fast link
+    _ask(sched, Control.ASKPULL, server, key=9, off=0, ver=1,
+         rep=[[w[2], 1000.0], [w[0], 1.0]])
+    [(_, d)] = _replies(van)
+    assert d["dest"] == w[2]
+
+
+def _single_tier(enable_ts, num_workers=3):
+    """1 scheduler + 1 server + N workers on localhost threads."""
+    port = free_port()
+    threads, errors = [], []
+    extra = dict(enable_intra_ts=enable_ts)
+
+    def run(fn):
+        def wrapped():
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+        t = threading.Thread(target=wrapped, daemon=True)
+        t.start()
+        threads.append(t)
+
+    def sched():
+        po = Postoffice(my_role=Role.SCHEDULER, is_global=False,
+                        root_uri="127.0.0.1", root_port=port,
+                        num_workers=num_workers, num_servers=1,
+                        cfg=Config(**extra))
+        po.start(60)
+        po.barrier(psbase.ALL_GROUP, timeout=60)
+        po.barrier(psbase.ALL_GROUP, timeout=300)
+        po.van.stop()
+
+    run(sched)
+    scfg = Config(role="server", ps_root_uri="127.0.0.1", ps_root_port=port,
+                  num_workers=num_workers, num_servers=1, **extra)
+    srv = KVStoreDistServer(scfg)
+    run(srv.run)
+    boxes = [[] for _ in range(num_workers)]
+    for i in range(num_workers):
+        wcfg = Config(role="worker", ps_root_uri="127.0.0.1",
+                      ps_root_port=port, num_workers=num_workers,
+                      num_servers=1, **extra)
+        run(lambda b=boxes[i], c=wcfg: b.append(KVStoreDist(cfg=c)))
+    for _ in range(300):
+        if errors:
+            raise errors[0]
+        if all(len(b) == 1 for b in boxes):
+            break
+        threading.Event().wait(0.1)
+    assert all(len(b) == 1 for b in boxes), "workers failed to start"
+    return [b[0] for b in boxes], threads, errors
+
+
+def test_intra_ts_single_tier_end_to_end():
+    """3 workers under ENABLE_INTRA_TS: gradients merge worker-to-worker,
+    one merged push hits the server, the model relays back; results match
+    the direct-push semantics exactly."""
+    kvs, threads, errors = _single_tier(enable_ts=True)
+    try:
+        rank0 = next(kv for kv in kvs if kv.rank == 0)
+        rank0.set_optimizer(SGD(learning_rate=0.5))
+        w0 = np.arange(12, dtype=np.float32)
+        _parallel([lambda kv=kv: kv.init(7, w0) for kv in kvs])
+
+        def step(kv, expect):
+            kv.push(7, np.ones(12, np.float32))
+            out = kv.pull(7)
+            kv.wait()
+            np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+        # each round: w -= 0.5 * sum(3 x ones) = w - 1.5
+        _parallel([lambda kv=kv: step(kv, w0 - 1.5) for kv in kvs])
+        _parallel([lambda kv=kv: step(kv, w0 - 3.0) for kv in kvs])
+        _parallel([lambda kv=kv: step(kv, w0 - 4.5) for kv in kvs])
+    finally:
+        _parallel([kv.close for kv in kvs])
+        for t in threads:
+            t.join(30)
+        if errors:
+            raise errors[0]
+
+
+def test_intra_ts_hips_two_tier():
+    """Full HiPS topology with intra-DC TSEngine: parity with the vanilla
+    FSA result (test_hips_fsa_vanilla)."""
+    topo = Topology(extra_cfg=dict(enable_intra_ts=True)).start(
+        sync_global=True)
+    try:
+        topo.master.set_optimizer(SGD(learning_rate=1.0))
+        w0 = np.arange(24, dtype=np.float32)
+        _parallel([lambda kv=kv: kv.init(0, w0)
+                   for kv in topo.workers + [topo.master]])
+
+        def step(kv, expect):
+            kv.push(0, np.ones(24, np.float32))
+            out = kv.pull(0)
+            kv.wait()
+            np.testing.assert_allclose(out, expect)
+
+        _parallel([lambda kv=kv: step(kv, w0 - 4.0) for kv in topo.workers])
+        _parallel([lambda kv=kv: step(kv, w0 - 8.0) for kv in topo.workers])
+    finally:
+        topo.stop()
+
+
+def test_inter_ts_hips_two_tier():
+    """HiPS with inter-DC TSEngine: party aggregates merge party-to-party
+    before one merged push reaches the global server; the fresh model
+    relays back through the party servers."""
+    topo = Topology(extra_cfg=dict(enable_inter_ts=True)).start(
+        sync_global=True)
+    try:
+        topo.master.set_optimizer(SGD(learning_rate=1.0))
+        w0 = np.arange(16, dtype=np.float32)
+        _parallel([lambda kv=kv: kv.init(0, w0)
+                   for kv in topo.workers + [topo.master]])
+
+        def step(kv, expect):
+            kv.push(0, np.ones(16, np.float32))
+            out = kv.pull(0)
+            kv.wait()
+            np.testing.assert_allclose(out, expect)
+
+        _parallel([lambda kv=kv: step(kv, w0 - 4.0) for kv in topo.workers])
+        _parallel([lambda kv=kv: step(kv, w0 - 8.0) for kv in topo.workers])
+    finally:
+        topo.stop()
+
+
+def test_intra_and_inter_ts_combined():
+    topo = Topology(extra_cfg=dict(enable_intra_ts=True,
+                                   enable_inter_ts=True)).start(
+        sync_global=True)
+    try:
+        topo.master.set_optimizer(SGD(learning_rate=1.0))
+        w0 = np.zeros(10, np.float32)
+        _parallel([lambda kv=kv: kv.init(0, w0)
+                   for kv in topo.workers + [topo.master]])
+
+        def step(kv, expect):
+            kv.push(0, np.ones(10, np.float32))
+            out = kv.pull(0)
+            kv.wait()
+            np.testing.assert_allclose(out, np.full(10, expect))
+
+        _parallel([lambda kv=kv: step(kv, -4.0) for kv in topo.workers])
+        _parallel([lambda kv=kv: step(kv, -8.0) for kv in topo.workers])
+    finally:
+        topo.stop()
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))
