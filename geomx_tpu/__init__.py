@@ -25,6 +25,7 @@ __version__ = "0.1.0"
 from geomx_tpu import config  # noqa: F401
 from geomx_tpu import kvstore as kv  # noqa: F401  (mirrors mx.kv)
 from geomx_tpu import optimizer  # noqa: F401
+from geomx_tpu import profiler  # noqa: F401  (mirrors mx.profiler)
 from geomx_tpu.kvstore import create  # noqa: F401
 
 # Mirror reference bootstrap: `import mxnet` on a node whose DMLC role is an

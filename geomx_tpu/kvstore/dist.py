@@ -359,6 +359,15 @@ class KVStoreDist(KVStore):
             self._send_command(Command.SET_GRADIENT_COMPRESSION,
                                json.dumps(self._compression_params))
 
+    def set_profiler_params(self, cmd: int, **params) -> None:
+        """Remotely drive the SERVER-side profilers (reference:
+        kvstore_dist.h:197-203 kSetProfilerParams; cmd is one of
+        profiler.CMD_SET_CONFIG/CMD_STATE/CMD_PAUSE/CMD_DUMP)."""
+        import json
+
+        self._send_command(Command.SET_PROFILER_PARAMS,
+                           json.dumps({"cmd": cmd, "params": params}))
+
     def _send_command(self, head: int, body: str) -> None:
         ts = self.kvw.request(head, body, psbase.SERVER_GROUP)
         self.kvw.wait(ts, 120.0)

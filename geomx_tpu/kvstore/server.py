@@ -50,6 +50,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from geomx_tpu import config as cfg_mod
+from geomx_tpu import profiler
 from geomx_tpu.compression import make_compressor
 from geomx_tpu.kvstore import sharding
 from geomx_tpu.kvstore.base import Command, DATA_INIT
@@ -93,6 +94,7 @@ class _KeyState:
         "pending_pulls", "initialized", "rounds", "offset", "length",
         "total", "dtype", "elems_received", "init_elems", "fwd_parts",
         "fwd_expected", "fwd_acks_left", "version", "pre_init_pushes",
+        "central_pushes",
     )
 
     def __init__(self, offset: int):
@@ -114,6 +116,7 @@ class _KeyState:
         self.fwd_expected = 0
         self.fwd_acks_left = 0
         self.version = 0
+        self.central_pushes = 0
         # gradient pushes that raced ahead of initialization (replayed)
         self.pre_init_pushes: List = []
 
@@ -263,6 +266,16 @@ class KVStoreDistServer:
             self._handle_command(req, srv, global_tier)
             return
         global_store = self.is_global_server or global_tier
+        if profiler.is_running():
+            tag = ("server.push" if req.push else "server.pull") + (
+                ".global" if global_tier else "")
+            with profiler.scope(tag, cat="kvstore"):
+                self._handle_data(req, kvs, srv, global_store, global_tier)
+            return
+        self._handle_data(req, kvs, srv, global_store, global_tier)
+
+    def _handle_data(self, req: ReqMeta, kvs: KVPairs, srv: KVServer,
+                     global_store: bool, global_tier: bool) -> None:
         acts: List[Action] = []
         with self._lock:
             for i, key in enumerate(kvs.keys):
@@ -422,8 +435,19 @@ class KVStoreDistServer:
 
         if not from_global_tier and not self.cfg.enable_central_worker:
             # central-worker gradients ignored (reference: :1281); unlike the
-            # reference we still ack so the pusher never hangs
-            return [lambda: srv.response(req)]
+            # reference we still ack so the pusher never hangs. With
+            # intra-TS the ignoring must still disseminate the CURRENT
+            # params, or the pusher's auto_pull would wait forever — the
+            # monotonic counter over-advances past any worker's push count,
+            # which auto_pull's >= comparison tolerates
+            acts = [lambda: srv.response(req)]
+            if self.ts_local is not None:
+                st.central_pushes += 1
+                data, total = st.stored.copy(), st.total
+                o, v = st.offset, st.rounds + st.central_pushes
+                acts.append(lambda: self.ts_local.offer_model(
+                    key, o, total, data, v))
+            return acts
 
         if not self.sync_global_mode:
             # MixedSync: update per arriving push, no barrier (reference:
@@ -434,7 +458,16 @@ class KVStoreDistServer:
                      if self.updater else st.stored)
             st.stored = np.asarray(new_w, dtype=st.dtype).ravel()
             st.version += 1
-            return [lambda: srv.response(req)]
+            acts = [lambda: srv.response(req)]
+            if self.ts_local is not None:
+                # MixedSync + intra-TS: st.version counts every arriving
+                # push, so it is >= any one worker's push count and
+                # satisfies their auto_pull version waits
+                data, total, o, v = (st.stored.copy(), st.total,
+                                     st.offset, st.version)
+                acts.append(lambda: self.ts_local.offer_model(
+                    key, o, total, data, v))
+            return acts
 
         # FSA: element-counted aggregation. Each PARTY covers the canonical
         # range exactly once per round across its local servers (a party's
@@ -482,6 +515,9 @@ class KVStoreDistServer:
             data, total, o, v = st.stored.copy(), st.total, rng.offset, st.rounds
             acts.append(lambda: self.ts_global.offer_model(key, o, total,
                                                            data, v))
+        # the global server's OWN local workers (central party) get their
+        # models via intra-TS dissemination too
+        acts += self._offer_local(st, key)
         return acts
 
 
@@ -587,15 +623,13 @@ class KVStoreDistServer:
         """Inter-TS: contribute each global slice to the overlay (merged
         party-to-party), watch for the disseminated model (reference: the
         TS_Push / AutoPull2 path)."""
-        from geomx_tpu.kvstore import sharding as _sh
-
         with self._lock:
             st = self._state(key, off)
             payload = st.stored
             total = st.total
             length = st.length
-            ranges = _sh.assign(key, total, self.po_global.num_servers,
-                                self.cfg.bigarray_bound)
+            ranges = sharding.assign(key, total, self.po_global.num_servers,
+                                     self.cfg.bigarray_bound)
             overlaps = []
             for rng in ranges:
                 lo = max(off, rng.offset)
@@ -635,10 +669,8 @@ class KVStoreDistServer:
                               ver: int) -> None:
         """Terminal inter-TS hop: deliver the party-merged aggregate slice
         to the global server that owns it."""
-        from geomx_tpu.kvstore import sharding as _sh
-
-        for rng in _sh.assign(key, total, self.po_global.num_servers,
-                              self.cfg.bigarray_bound):
+        for rng in sharding.assign(key, total, self.po_global.num_servers,
+                                   self.cfg.bigarray_bound):
             lo = max(off, rng.offset)
             hi = min(off + arr.size, rng.offset + rng.length)
             if lo >= hi:
@@ -667,10 +699,13 @@ class KVStoreDistServer:
     def _uniq(reqs):
         """Collapse duplicated (req, srv) ack entries: a TSEngine final
         push appears ``num_merge`` times in the round's request list but
-        must be acked exactly once."""
+        must be acked exactly once. The KVServer identity is part of the
+        key — both tiers use the same node-id scheme and independent
+        timestamp counters, so (sender, timestamp) alone could collapse a
+        local-tier and a global-tier request into one."""
         seen = {}
         for r, s in reqs:
-            seen[(r.sender, r.timestamp)] = (r, s)
+            seen[(r.sender, r.timestamp, r.customer_id, id(s))] = (r, s)
         return list(seen.values())
 
     def _offer_local(self, st: "_KeyState", key: int) -> List[Action]:
@@ -799,7 +834,20 @@ class KVStoreDistServer:
         elif head == Command.SET_GRADIENT_COMPRESSION:
             self.gc = make_compressor(json.loads(body))
         elif head == Command.SET_PROFILER_PARAMS:
-            pass  # profiler integration lands with the aux subsystems
+            # workers remotely drive this server's profiler (reference:
+            # ProcessServerProfilerCommands, kvstore_dist_server.h:383-430).
+            # NOTE: must use the module-level import — handler threads run
+            # while the server's main thread is blocked inside
+            # ``import geomx_tpu``, so a function-local geomx_tpu import
+            # here deadlocks on the package import lock.
+            # The prefix must be CLUSTER-unique: every party's server 0
+            # shares local rank 0, so in HiPS topologies we use the
+            # global-tier node id instead (divergence from the reference's
+            # local rank, kvstore_dist_server.h:415, which clobbers files
+            # when parties share a filesystem)
+            uid = (self.po_global.my_id if self.po_global is not None
+                   else self.po_local.my_rank)
+            profiler.apply_remote_command(body, uid)
         srv.response(req)
         if not global_tier:
             self._rebroadcast_command(head, body)
@@ -829,7 +877,8 @@ class KVStoreDistServer:
         if not self.is_global_server or self.po_global is None:
             return
         if head not in (Command.CONTROLLER, Command.SET_GRADIENT_COMPRESSION,
-                        Command.SYNC_GLOBAL_MODE):
+                        Command.SYNC_GLOBAL_MODE,
+                        Command.SET_PROFILER_PARAMS):
             return
         # both tiers: other global servers + party servers (global workers)
         targets = [psbase.server_rank_to_id(r)

@@ -50,6 +50,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from geomx_tpu.ps import base
+from geomx_tpu.ps.kv_app import KVPairs
 from geomx_tpu.ps.message import Control, Message, Meta
 
 log = logging.getLogger("geomx.tsengine")
@@ -271,7 +272,6 @@ class TSNode:
             is_global=self.po.is_global)))
 
     def _on_push_reply(self, key: int, off: int, ver: int, dest: int) -> None:
-        from geomx_tpu.ps.kv_app import KVPairs
 
         with self._lock:
             slot = self._slots.get((key, off))
@@ -323,13 +323,13 @@ class TSNode:
                 with self._lock:
                     slot = self._slot(key, off)
                     if slot.ver < req.version:
-                        slot.buf = val.astype(np.float32).copy()
+                        slot.buf = val.astype(np.float32)
                         slot.nm = req.num_merge
                         slot.ver = req.version
                         slot.sent = False
                     elif slot.ver == req.version:
                         slot.buf = (slot.buf + val if slot.buf is not None
-                                    else val.astype(np.float32).copy())
+                                    else val.astype(np.float32))
                         slot.nm += req.num_merge
                     else:
                         app.response(req)  # stale hop: ack and drop
@@ -373,7 +373,6 @@ class TSNode:
             is_global=self.po.is_global)))
 
     def _on_pull_reply(self, key: int, off: int, ver: int, dest: int) -> None:
-        from geomx_tpu.ps.kv_app import KVPairs
 
         if dest == DONE_DEST:
             return

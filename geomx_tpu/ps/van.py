@@ -32,6 +32,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from geomx_tpu import profiler
 from geomx_tpu.ps import base
 from geomx_tpu.ps import dgt as dgt_mod
 from geomx_tpu.ps.message import (Control, Message, Meta, Node, Role,
@@ -341,6 +342,17 @@ class Van:
                           msg.meta.recver, e)
 
     def _send_one(self, target: int, msg: Message) -> int:
+        if profiler.is_running() and not msg.is_control:
+            t0 = time.monotonic()
+            n = self._send_one_inner(target, msg)
+            profiler.record(
+                "van.send", "transport", (t0 - profiler._t0) * 1e6,
+                (time.monotonic() - t0) * 1e6,
+                {"to": target, "bytes": n})
+            return n
+        return self._send_one_inner(target, msg)
+
+    def _send_one_inner(self, target: int, msg: Message) -> int:
         buf = msg.pack()
         for attempt in (0, 1):
             conn = self._get_conn(target)

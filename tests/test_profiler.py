@@ -1,0 +1,160 @@
+"""Profiler tests: chrome-trace recording + the remote command channel.
+
+Reference behaviors covered: Profiler SetState/DumpProfile emitting
+chrome-tracing JSON (src/profiler/profiler.h:270,304) and worker-driven
+server profiler control with rank-prefixed dump files
+(KVStoreServerProfilerCommand, include/mxnet/kvstore.h:49;
+kvstore_dist_server.h:383-430).
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from geomx_tpu import profiler
+from geomx_tpu.config import Config
+from geomx_tpu.kvstore.dist import KVStoreDist
+from geomx_tpu.kvstore.server import KVStoreDistServer
+from geomx_tpu.optimizer import SGD
+from geomx_tpu.ps import base as psbase
+from geomx_tpu.ps.message import Role
+from geomx_tpu.ps.postoffice import Postoffice
+
+from test_hips import _parallel, free_port
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiler():
+    profiler.reset()
+    yield
+    profiler.reset()
+
+
+def test_scope_records_chrome_trace_events(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "trace.json"),
+                        aggregate_stats=True)
+    profiler.set_state("run")
+    with profiler.scope("work", cat="test"):
+        pass
+    profiler.counter("queue_depth", 3)
+    profiler.set_state("stop")
+    path = profiler.dump()
+    doc = json.loads(open(path).read())
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert "work" in names and "queue_depth" in names
+    ev = next(e for e in doc["traceEvents"] if e["name"] == "work")
+    assert ev["ph"] == "X" and ev["dur"] >= 0 and ev["cat"] == "test"
+    assert profiler.aggregate_stats().get("work", 0) >= 0
+
+
+def test_paused_and_stopped_record_nothing():
+    profiler.set_state("run")
+    profiler.pause()
+    with profiler.scope("hidden"):
+        pass
+    profiler.resume()
+    profiler.set_state("stop")
+    with profiler.scope("hidden2"):
+        pass
+    assert json.loads(profiler.dumps())["traceEvents"] == []
+
+
+def test_dump_clears_when_finished(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "t.json"))
+    profiler.set_state("run")
+    with profiler.scope("once"):
+        pass
+    profiler.dump(finished=True)
+    assert json.loads(profiler.dumps())["traceEvents"] == []
+
+
+def test_remote_command_rank_prefixes_dump(tmp_path):
+    body = json.dumps({"cmd": profiler.CMD_SET_CONFIG,
+                       "params": {"filename": str(tmp_path / "p.json")}})
+    profiler.apply_remote_command(body, rank=2)
+    profiler.apply_remote_command(
+        json.dumps({"cmd": profiler.CMD_STATE, "params": {"state": "run"}}), 2)
+    with profiler.scope("server_work"):
+        pass
+    profiler.apply_remote_command(
+        json.dumps({"cmd": profiler.CMD_DUMP, "params": {}}), 2)
+    out = tmp_path / "rank2_p.json"
+    assert out.exists()
+    doc = json.loads(out.read_text())
+    assert any(e["name"] == "server_work" for e in doc["traceEvents"])
+
+
+def test_worker_drives_server_profiler_end_to_end(tmp_path):
+    """A worker remotely configures, runs, and dumps the server's
+    profiler; the dump lands rank-prefixed and contains server.push
+    scopes from real request handling."""
+    port = free_port()
+    threads, errors = [], []
+
+    def run(fn):
+        def w():
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+        t = threading.Thread(target=w, daemon=True)
+        t.start()
+        threads.append(t)
+
+    def sched():
+        po = Postoffice(my_role=Role.SCHEDULER, is_global=False,
+                        root_uri="127.0.0.1", root_port=port,
+                        num_workers=1, num_servers=1, cfg=Config())
+        po.start(60)
+        po.barrier(psbase.ALL_GROUP, timeout=60)
+        po.barrier(psbase.ALL_GROUP, timeout=120)
+        po.van.stop()
+
+    run(sched)
+    scfg = Config(role="server", ps_root_uri="127.0.0.1", ps_root_port=port,
+                  num_workers=1, num_servers=1)
+    srv = KVStoreDistServer(scfg)
+    run(srv.run)
+    box = []
+    wcfg = Config(role="worker", ps_root_uri="127.0.0.1", ps_root_port=port,
+                  num_workers=1, num_servers=1)
+    run(lambda: box.append(KVStoreDist(cfg=wcfg)))
+    for _ in range(300):
+        if errors:
+            raise errors[0]
+        if box:
+            break
+        threading.Event().wait(0.1)
+    kv = box[0]
+    try:
+        kv.set_optimizer(SGD(learning_rate=1.0))
+        kv.set_profiler_params(profiler.CMD_SET_CONFIG,
+                               filename=str(tmp_path / "srv.json"))
+        kv.set_profiler_params(profiler.CMD_STATE, state="run")
+        kv.init(0, np.ones(4, np.float32))
+        kv.push(0, np.ones(4, np.float32))
+        out = kv.pull(0)
+        kv.wait()
+        np.testing.assert_allclose(out, np.zeros(4))
+        kv.set_profiler_params(profiler.CMD_STATE, state="stop")
+        kv.set_profiler_params(profiler.CMD_DUMP)
+        dump = tmp_path / "rank0_srv.json"
+        assert dump.exists()
+        doc = json.loads(dump.read_text())
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert "server.push" in names
+    finally:
+        kv.close()
+        for t in threads:
+            t.join(30)
+        if errors:
+            raise errors[0]
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))
