@@ -85,6 +85,11 @@ def _now_us() -> float:
     return (time.monotonic() - _t0) * 1e6
 
 
+def now_us() -> float:
+    """Current time on the profiler clock (µs since profiler epoch)."""
+    return _now_us()
+
+
 def record(name: str, cat: str, ts_us: float, dur_us: float,
            args: Optional[Dict[str, Any]] = None) -> None:
     """Record one complete ('X') trace event."""

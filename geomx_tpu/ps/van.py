@@ -35,6 +35,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from geomx_tpu import profiler
 from geomx_tpu.ps import base
 from geomx_tpu.ps import dgt as dgt_mod
+from geomx_tpu.ps import native as native_mod
 from geomx_tpu.ps.message import (Control, Message, Meta, Node, Role,
                                   read_message)
 
@@ -121,6 +122,12 @@ class Van:
         self._node_udp: Dict[int, List[int]] = {}
         self._udp_send_sock: Optional[socket.socket] = None
 
+        # transport backend: the native C++ core (native/transport.cc —
+        # our ZMQVan equivalent) when buildable and not disabled via
+        # GEOMX_NATIVE_VAN=0; pure-Python sockets otherwise. Both speak
+        # the same wire format and interoperate within one job.
+        self._native: Optional["native_mod.NativeTransport"] = None
+        self.use_native = native_mod.enabled()
         self._listener: Optional[socket.socket] = None
         self._threads: List[threading.Thread] = []
         self._send_queue: List[Tuple[int, int, Message]] = []
@@ -135,7 +142,10 @@ class Van:
 
     def start(self, timeout: float = 60.0) -> None:
         self._bind()
-        self._spawn(self._accept_loop, "van-accept")
+        if self._native is not None:
+            self._spawn(self._native_recv_loop, "van-nrecv")
+        else:
+            self._spawn(self._accept_loop, "van-accept")
         if self._dgt_cfg is not None:
             self._start_dgt()
         if self.use_priority_send:
@@ -157,6 +167,7 @@ class Van:
             self._spawn(self._heartbeat_loop, "van-heartbeat")
 
     def stop(self) -> None:
+        log.debug("%s van.stop()", self._tag())
         self.stopped.set()
         with self._send_cv:
             self._send_cv.notify_all()
@@ -172,6 +183,8 @@ class Van:
                 self._udp_send_sock.close()
             except OSError:
                 pass
+        if self._native is not None:
+            self._native.stop()
         if self._listener is not None:
             try:
                 self._listener.close()
@@ -186,15 +199,48 @@ class Van:
             self._conns.clear()
 
     def _bind(self) -> None:
+        port = self.root_port if self.is_scheduler else 0
+        if self.use_native:
+            try:
+                self._native = native_mod.NativeTransport(self.bind_host, port)
+                self.my_port = self._native.port
+                return
+            except (OSError, RuntimeError) as e:
+                log.warning("native transport bind failed (%s); "
+                            "falling back to Python sockets", e)
+                self._native = None
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        if self.is_scheduler:
-            s.bind((self.bind_host, self.root_port))
-        else:
-            s.bind((self.bind_host, 0))
+        s.bind((self.bind_host, port))
         s.listen(128)
         self._listener = s
         self.my_port = s.getsockname()[1]
+
+    def _native_recv_loop(self) -> None:
+        """Drain complete frames from the native core's inbound queue."""
+        assert self._native is not None
+        while not self.stopped.is_set():
+            try:
+                buf = self._native.recv(timeout_s=0.5)
+            except ConnectionAbortedError:
+                return
+            if buf is None:
+                continue
+            self.recv_bytes += len(buf)
+            try:
+                msg = Message.unpack(buf)
+                if (
+                    self.drop_rate > 0
+                    and not msg.is_control
+                    and random.random() < self.drop_rate
+                ):
+                    if self.verbose:
+                        log.info("PS_DROP_MSG: dropping frame from %d",
+                                 msg.meta.sender)
+                    continue
+                self._process(msg)
+            except Exception:
+                log.exception("error processing inbound frame; loop kept")
 
     def _start_dgt(self) -> None:
         """Bind UDP channels + spawn schedulers (reference: van.cc:613-646)."""
@@ -313,6 +359,15 @@ class Van:
                         self._send_queue, (-m.meta.priority, next(self._send_seq), m)
                     )
                     self._send_cv.notify()
+            elif len(targets) > 1:
+                # group fan-out: one unreachable member (e.g. a peer that
+                # already tore down during shutdown) must not starve the
+                # rest — a lost barrier release deadlocks every survivor
+                try:
+                    total += self._send_one(t, m)
+                except OSError as e:
+                    log.warning("%s group send to %d failed: %s",
+                                self._tag(), t, e)
             else:
                 total += self._send_one(t, m)
         return total
@@ -343,17 +398,26 @@ class Van:
 
     def _send_one(self, target: int, msg: Message) -> int:
         if profiler.is_running() and not msg.is_control:
-            t0 = time.monotonic()
+            t0 = profiler.now_us()
             n = self._send_one_inner(target, msg)
             profiler.record(
-                "van.send", "transport", (t0 - profiler._t0) * 1e6,
-                (time.monotonic() - t0) * 1e6,
+                "van.send", "transport", t0, profiler.now_us() - t0,
                 {"to": target, "bytes": n})
             return n
         return self._send_one_inner(target, msg)
 
     def _send_one_inner(self, target: int, msg: Message) -> int:
         buf = msg.pack()
+        if self._native is not None:
+            addr = self.node_table.get(target)
+            if addr is None:
+                raise OSError(f"no route to node {target}")
+            # set_route is a no-op when unchanged; on an address change it
+            # evicts the cached connection (peer recovered elsewhere)
+            self._native.set_route(target, addr[0], addr[1])
+            n = self._native.send(target, buf)
+            self.send_bytes += n
+            return n
         for attempt in (0, 1):
             conn = self._get_conn(target)
             if conn is None:
@@ -408,6 +472,9 @@ class Van:
     def _send_to_addr(self, addr: Tuple[str, int], msg: Message) -> None:
         """One-shot registration send before the node table exists."""
         msg.meta.sender = self.my_id
+        if self._native is not None:
+            self._native.send_to_addr(addr[0], addr[1], msg.pack())
+            return
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         sock.settimeout(10.0)
         sock.connect(addr)
@@ -469,10 +536,13 @@ class Van:
             self.stopped.set()
         elif cmd in (Control.ASKPUSH, Control.ASKPULL, Control.REPLY,
                      Control.AUTOPULLREPLY):
-            # TSEngine matchmaking (reference: van.cc:1197-1458)
-            h = self.ts_handler
-            if h is not None:
-                h(msg)
+            # TSEngine matchmaking (reference: van.cc:1197-1458). Handlers
+            # may themselves send (model relays) and block on a slow peer;
+            # dispatch on a dedicated thread so a stalled relay can never
+            # freeze the receive path (fatal for the native backend's
+            # single recv thread).
+            if self.ts_handler is not None:
+                self._ts_dispatch(msg)
             else:
                 log.warning("TS control message but TSEngine not enabled "
                             "on this node (cmd=%d)", cmd)
@@ -521,6 +591,9 @@ class Van:
         with self._reg_lock:
             expected = self.num_workers + self.num_servers
             dead = self.dead_nodes()
+            log.debug("%s registration %s:%d role=%d (have %d/%d, dead=%s)",
+                      self._tag(), node.hostname, node.port, node.role,
+                      len(self._registrations), expected, dead)
             if len(self._registrations) >= expected and dead:
                 # recovery path: hand the dead slot's id to the newcomer
                 # (reference: van.cc:176-193)
@@ -623,6 +696,9 @@ class Van:
                     base.expand_group(group, self.num_workers, self.num_servers)
                 )
                 done = self._barrier_counts[group] >= expected
+                log.debug("%s barrier req group=%d from=%d count=%d/%d",
+                          self._tag(), group, msg.meta.sender,
+                          self._barrier_counts[group], expected)
                 if done:
                     self._barrier_counts[group] = 0
             if done:
@@ -680,6 +756,35 @@ class Van:
         return dead
 
     # ------------------------------------------------------------------
+
+    def _ts_dispatch(self, msg: Message) -> None:
+        """Hand a TS control message to the lazily-started TS thread."""
+        with self._send_cv:  # reuse an existing lock for lazy init
+            if not hasattr(self, "_ts_queue"):
+                import queue as _queue
+
+                self._ts_queue: "_queue.Queue[Message]" = _queue.Queue()
+                self._spawn(self._ts_loop, "van-ts")
+        self._ts_queue.put(msg)
+
+    def _ts_loop(self) -> None:
+        while not self.stopped.is_set():
+            try:
+                msg = self._ts_queue.get(timeout=0.5)
+            except Exception:
+                continue
+            h = self.ts_handler
+            if h is None:
+                continue
+            try:
+                h(msg)
+            except Exception:
+                log.exception("TS handler failed; dispatcher kept")
+
+    def _tag(self) -> str:
+        """Log identity: tier, id, and bind port."""
+        return (f"[{'g' if self.is_global else 'l'}"
+                f"/{self.my_id}@{getattr(self, 'my_port', '?')}]")
 
     def _spawn(self, fn, name: str, *args) -> None:
         t = threading.Thread(target=fn, args=args, name=name, daemon=True)

@@ -1,0 +1,219 @@
+"""Native (C++) transport core tests.
+
+The native core (native/transport.cc) replaces the Python van's socket
+layer the way ZMQVan underlies ps-lite's Van in the reference
+(3rdparty/ps-lite/src/zmq_van.h:41-516). Both backends speak the identical
+wire format, so a topology may mix native and pure-Python nodes — the
+mixed-tier test below proves it.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from geomx_tpu.ps import base, native
+from geomx_tpu.ps.kv_app import KVPairs, KVServer, KVWorker
+from geomx_tpu.ps.message import Message, Meta, Node, Role
+
+from test_transport import free_port, make_tier, shutdown
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native transport not buildable")
+
+
+def test_build_and_bind():
+    t = native.NativeTransport("127.0.0.1", 0)
+    assert t.port > 0
+    t.close()
+
+
+def test_frame_roundtrip_and_order():
+    a = native.NativeTransport("127.0.0.1", 0)
+    b = native.NativeTransport("127.0.0.1", 0)
+    try:
+        a.set_route(7, "127.0.0.1", b.port)
+        frames = []
+        for i in range(50):
+            m = Message(Meta(sender=1, recver=7, timestamp=i))
+            m.add_array(np.full((16,), float(i), dtype=np.float32))
+            buf = m.pack()
+            frames.append(buf)
+            a.send(7, buf)
+        for i in range(50):
+            got = b.recv(timeout_s=5.0)
+            assert got == frames[i]  # byte-exact, in order
+            m = Message.unpack(got)
+            assert m.meta.timestamp == i
+            np.testing.assert_allclose(m.get_array(0), float(i))
+        assert a.send_bytes == sum(len(f) for f in frames)
+        assert b.recv_bytes == a.send_bytes
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_timeout_and_stop():
+    t = native.NativeTransport("127.0.0.1", 0)
+    assert t.recv(timeout_s=0.05) is None
+    t.stop()
+    with pytest.raises(ConnectionAbortedError):
+        t.recv(timeout_s=1.0)
+    t.close()
+
+
+def test_send_no_route():
+    t = native.NativeTransport("127.0.0.1", 0)
+    with pytest.raises(OSError, match="no route"):
+        t.send(42, b"x")
+    t.close()
+
+
+def test_route_change_evicts_connection():
+    """Re-pointing an id at a new address must reach the new peer."""
+    a = native.NativeTransport("127.0.0.1", 0)
+    b1 = native.NativeTransport("127.0.0.1", 0)
+    b2 = native.NativeTransport("127.0.0.1", 0)
+    try:
+        msg = Message(Meta(recver=5)).pack()
+        a.set_route(5, "127.0.0.1", b1.port)
+        a.send(5, msg)
+        assert b1.recv(timeout_s=5.0) == msg
+        # peer "recovers" at a new port
+        a.set_route(5, "127.0.0.1", b2.port)
+        a.send(5, msg)
+        assert b2.recv(timeout_s=5.0) == msg
+        assert b1.recv(timeout_s=0.1) is None
+    finally:
+        a.close()
+        b1.close()
+        b2.close()
+
+
+def test_send_to_addr_oneshot():
+    a = native.NativeTransport("127.0.0.1", 0)
+    b = native.NativeTransport("127.0.0.1", 0)
+    try:
+        msg = Message(Meta(recver=1, control_cmd=2,
+                           nodes=[Node(role=Role.WORKER, port=1234)])).pack()
+        a.send_to_addr("127.0.0.1", b.port, msg)
+        assert b.recv(timeout_s=5.0) == msg
+    finally:
+        a.close()
+        b.close()
+
+
+def test_redial_after_peer_restart():
+    """A cached connection to a dead peer is evicted and redialed."""
+    a = native.NativeTransport("127.0.0.1", 0)
+    b = native.NativeTransport("127.0.0.1", 0)
+    port = b.port
+    msg = Message(Meta(recver=5)).pack()
+    try:
+        a.set_route(5, "127.0.0.1", port)
+        a.send(5, msg)
+        assert b.recv(timeout_s=5.0) == msg
+        b.close()
+        # peer restarts on the same port
+        b = native.NativeTransport("127.0.0.1", port)
+        # first send may fail (stale fd detected mid-send) — the van layer
+        # retries; at most two attempts needed
+        for _ in range(3):
+            try:
+                a.send(5, msg)
+                break
+            except OSError:
+                pass
+        assert b.recv(timeout_s=5.0) == msg
+    finally:
+        a.close()
+        b.close()
+
+
+def test_native_tier_push_pull():
+    """Full rendezvous + KV push/pull over the native backend (default-on)."""
+    sched, servers, workers = make_tier(num_workers=2, num_servers=1)
+    store = {}
+    try:
+        assert sched.van._native is not None, "native backend not engaged"
+        server = KVServer(servers[0])
+
+        def handle(req, kvs, srv):
+            if req.push:
+                for k, v in zip(kvs.keys, kvs.vals):
+                    store[k] = store.get(k, 0) + v
+                srv.response(req)
+            elif req.pull:
+                srv.response(req, KVPairs(
+                    keys=kvs.keys, vals=[store[k] for k in kvs.keys]))
+
+        server.set_request_handle(handle)
+        w0, w1 = KVWorker(workers[0]), KVWorker(workers[1])
+        v = np.ones((4, 3), dtype=np.float32)
+        ts0 = w0.push(KVPairs(keys=[7], vals=[v]), server_rank=0)
+        ts1 = w1.push(KVPairs(keys=[7], vals=[2 * v]), server_rank=0)
+        w0.wait(ts0, 10)
+        w1.wait(ts1, 10)
+        ts = w0.pull([7], server_rank=0)
+        w0.wait(ts, 10)
+        (resp,) = w0.take_response(ts)
+        np.testing.assert_allclose(resp.vals[0], 3 * v)
+    finally:
+        shutdown(sched, *servers, *workers)
+
+
+def test_mixed_backend_tier_interop():
+    """Native and pure-Python nodes interoperate in one tier."""
+    import geomx_tpu.ps.postoffice as postoffice_mod
+
+    port = free_port()
+    kw = dict(is_global=False, root_uri="127.0.0.1", root_port=port,
+              num_workers=2, num_servers=1)
+    sched = postoffice_mod.Postoffice(my_role=Role.SCHEDULER, **kw)
+    server = postoffice_mod.Postoffice(my_role=Role.SERVER, **kw)
+    w_native = postoffice_mod.Postoffice(my_role=Role.WORKER, **kw)
+    w_python = postoffice_mod.Postoffice(my_role=Role.WORKER, **kw)
+    # force one worker (and the server) onto the pure-Python backend
+    server.van.use_native = False
+    w_python.van.use_native = False
+    threads = []
+    for po in (sched, server, w_native, w_python):
+        t = threading.Thread(target=po.start, daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(20)
+    store = {}
+    try:
+        assert w_native.van._native is not None
+        assert w_python.van._native is None and server.van._native is None
+        srv = KVServer(server)
+
+        def handle(req, kvs, s):
+            if req.push:
+                for k, v in zip(kvs.keys, kvs.vals):
+                    store[k] = store.get(k, 0) + v
+                s.response(req)
+            elif req.pull:
+                s.response(req, KVPairs(
+                    keys=kvs.keys, vals=[store[k] for k in kvs.keys]))
+
+        srv.set_request_handle(handle)
+        a, b = KVWorker(w_native), KVWorker(w_python)
+        v = np.arange(12, dtype=np.float32).reshape(3, 4)
+        ta = a.push(KVPairs(keys=[1], vals=[v]), server_rank=0)
+        tb = b.push(KVPairs(keys=[1], vals=[v]), server_rank=0)
+        a.wait(ta, 10)
+        b.wait(tb, 10)
+        ts = b.pull([1], server_rank=0)
+        b.wait(ts, 10)
+        (resp,) = b.take_response(ts)
+        np.testing.assert_allclose(resp.vals[0], 2 * v)
+    finally:
+        shutdown(sched, server, w_native, w_python)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))
