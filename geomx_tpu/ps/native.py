@@ -156,6 +156,9 @@ class NativeTransport:
         n = self._lib.gx_recv(self._h, ctypes.byref(out), timeout_s)
         if n == -1:
             return None
+        if n == -3:
+            # transient allocation failure; the frame stays queued
+            raise MemoryError("native recv allocation failed")
         if n < 0:
             raise ConnectionAbortedError("native transport stopped")
         try:

@@ -50,6 +50,8 @@ class Postoffice:
             num_servers=num_servers,
             bind_host=cfg.node_host or "127.0.0.1",
             drop_rate=cfg.drop_rate,
+            resend_timeout_s=(cfg.resend_timeout_ms / 1000.0
+                              if cfg.resend else 0.0),
             heartbeat_interval_s=cfg.heartbeat_interval_s,
             heartbeat_timeout_s=cfg.heartbeat_timeout_s,
             use_priority_send=cfg.enable_p3 and my_role == Role.WORKER,

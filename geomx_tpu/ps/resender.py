@@ -1,0 +1,151 @@
+"""ACK-based retransmission for van messages.
+
+Plays the role of ps-lite's ``Resender`` (reference:
+3rdparty/ps-lite/src/resender.h:15-141): every eligible outbound message
+carries a unique signature (``msg_sig``); the receiver replies with an ACK
+control frame carrying the same signature and drops duplicate signatures
+it has already accepted; a monitor thread re-sends messages whose ACK has
+not arrived within ``PS_RESEND_TIMEOUT`` milliseconds.
+
+Deltas from the reference, on purpose:
+- signatures are a per-van nonce (node id + counter) instead of a content
+  hash — collision-free and cheaper than hashing tensor payloads;
+- the receiver ACKs *after* the message was dispatched without raising, so
+  retransmits re-drive a handler that failed (at-least-once semantics);
+- retries are capped (``max_retries``, default 10) so a permanently dead
+  peer cannot accumulate an unbounded resend queue — the reference leans
+  on heartbeat-based dead-node eviction for that instead.
+
+Enabled via ``PS_RESEND=1`` (reference: van.cc:527-533). Pairs with the
+``PS_DROP_MSG`` fault injection: a lossy van with resend enabled must
+still complete every push/pull (tested in tests/test_resender.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import TYPE_CHECKING, Deque, Dict, Set, Tuple
+
+from geomx_tpu.ps.message import Control, Message, Meta
+
+if TYPE_CHECKING:  # pragma: no cover
+    from geomx_tpu.ps.van import Van
+
+log = logging.getLogger("geomx.resender")
+
+_DEDUP_WINDOW = 100_000  # remembered accepted signatures
+
+
+class Resender:
+    """Tracks in-flight messages for one van and re-sends unACKed ones."""
+
+    def __init__(self, van: "Van", timeout_s: float, max_retries: int = 10):
+        self.van = van
+        self.timeout_s = timeout_s
+        self.max_retries = max_retries
+        self._lock = threading.Lock()
+        # sig -> (target, message, first_send_monotonic, num_resends)
+        self._outgoing: "OrderedDict[int, Tuple[int, Message, float, int]]" = (
+            OrderedDict())
+        self._seen: Set[int] = set()
+        self._seen_order: Deque[int] = deque()
+        # seed the counter from the wall clock so a recovered node (same
+        # id, fresh Resender) never reuses an old incarnation's signatures
+        # — peers' dedup windows would silently swallow the new messages
+        self._counter = itertools.count(
+            (time.time_ns() >> 16) & ((1 << 43) - 1))
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._monitor, name="van-resend", daemon=True)
+        self._thread.start()
+        self.num_resends = 0
+        self.num_duplicates = 0
+
+    # -- sender side -----------------------------------------------------
+
+    def assign_sig(self, msg: Message) -> int:
+        """Unique signature: node id in the high bits, counter in the low."""
+        sig = ((self.van.my_id & 0xFFFF) << 44) | next(self._counter)
+        msg.meta.msg_sig = sig
+        return sig
+
+    def add_outgoing(self, target: int, msg: Message) -> None:
+        with self._lock:
+            self._outgoing[msg.meta.msg_sig] = (target, msg,
+                                                time.monotonic(), 0)
+
+    def handle_ack(self, sig: int) -> None:
+        with self._lock:
+            self._outgoing.pop(sig, None)
+
+    # -- receiver side ---------------------------------------------------
+
+    def is_duplicate(self, sig: int) -> bool:
+        with self._lock:
+            if sig in self._seen:
+                self.num_duplicates += 1
+                return True
+            return False
+
+    def mark_seen(self, sig: int) -> None:
+        """Record an accepted signature — call only after the message was
+        dispatched without raising, so a retransmit re-drives a failed
+        handler instead of being swallowed as a duplicate."""
+        with self._lock:
+            if sig in self._seen:
+                return
+            self._seen.add(sig)
+            self._seen_order.append(sig)
+            if len(self._seen_order) > _DEDUP_WINDOW:
+                self._seen.discard(self._seen_order.popleft())
+
+    def send_ack(self, msg: Message) -> None:
+        """ACK an accepted (or duplicate) inbound message back to its sender."""
+        ack = Message(Meta(
+            recver=msg.meta.sender,
+            sender=self.van.my_id,
+            control_cmd=Control.ACK,
+            msg_sig=msg.meta.msg_sig,
+            is_global=self.van.is_global,
+        ))
+        try:
+            self.van._send_one(msg.meta.sender, ack)
+        except OSError:
+            # sender unreachable (teardown); it will retransmit or give up
+            pass
+
+    # -- monitor ---------------------------------------------------------
+
+    def _monitor(self) -> None:
+        period = max(self.timeout_s / 4.0, 0.02)
+        while not self._stopped.wait(period):
+            now = time.monotonic()
+            to_resend = []
+            with self._lock:
+                for sig, (target, msg, t_sent, n) in list(self._outgoing.items()):
+                    if now - t_sent < self.timeout_s * (n + 1):
+                        continue
+                    if n >= self.max_retries:
+                        log.error("giving up on msg sig=%x to %d after %d "
+                                  "resends", sig, target, n)
+                        self._outgoing.pop(sig, None)
+                        continue
+                    self._outgoing[sig] = (target, msg, t_sent, n + 1)
+                    to_resend.append((target, msg))
+            for target, msg in to_resend:
+                self.num_resends += 1
+                try:
+                    self.van._send_one(target, msg)
+                except OSError as e:
+                    log.debug("resend to %d failed (%s); will retry", target, e)
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._outgoing)
+
+    def stop(self) -> None:
+        self._stopped.set()

@@ -36,6 +36,7 @@ from geomx_tpu import profiler
 from geomx_tpu.ps import base
 from geomx_tpu.ps import dgt as dgt_mod
 from geomx_tpu.ps import native as native_mod
+from geomx_tpu.ps import resender as resender_mod
 from geomx_tpu.ps.message import (Control, Message, Meta, Node, Role,
                                   read_message)
 
@@ -56,6 +57,7 @@ class Van:
         num_servers: int,
         bind_host: str = "127.0.0.1",
         drop_rate: float = 0.0,
+        resend_timeout_s: float = 0.0,
         heartbeat_interval_s: float = 0.0,
         heartbeat_timeout_s: float = 60.0,
         use_priority_send: bool = False,
@@ -70,6 +72,9 @@ class Van:
         self.num_servers = num_servers
         self.bind_host = bind_host
         self.drop_rate = drop_rate
+        self.resend_timeout_s = resend_timeout_s
+        # ACK/retransmit layer (reference: resender.h, PS_RESEND)
+        self._resender: Optional["resender_mod.Resender"] = None
         self.heartbeat_interval_s = heartbeat_interval_s
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.use_priority_send = use_priority_send
@@ -142,6 +147,8 @@ class Van:
 
     def start(self, timeout: float = 60.0) -> None:
         self._bind()
+        if self.resend_timeout_s > 0:
+            self._resender = resender_mod.Resender(self, self.resend_timeout_s)
         if self._native is not None:
             self._spawn(self._native_recv_loop, "van-nrecv")
         else:
@@ -169,6 +176,8 @@ class Van:
     def stop(self) -> None:
         log.debug("%s van.stop()", self._tag())
         self.stopped.set()
+        if self._resender is not None:
+            self._resender.stop()
         with self._send_cv:
             self._send_cv.notify_all()
         if self._dgt_queues is not None:
@@ -224,6 +233,10 @@ class Van:
                 buf = self._native.recv(timeout_s=0.5)
             except ConnectionAbortedError:
                 return
+            except MemoryError:
+                log.error("native recv allocation failure; retrying")
+                time.sleep(0.1)
+                continue
             if buf is None:
                 continue
             self.recv_bytes += len(buf)
@@ -359,10 +372,11 @@ class Van:
                         self._send_queue, (-m.meta.priority, next(self._send_seq), m)
                     )
                     self._send_cv.notify()
-            elif len(targets) > 1:
-                # group fan-out: one unreachable member (e.g. a peer that
+            elif len(targets) > 1 and m.is_control:
+                # control fan-out: one unreachable member (e.g. a peer that
                 # already tore down during shutdown) must not starve the
-                # rest — a lost barrier release deadlocks every survivor
+                # rest — a lost barrier release deadlocks every survivor.
+                # Data fan-outs still raise so callers see the failure.
                 try:
                     total += self._send_one(t, m)
                 except OSError as e:
@@ -407,6 +421,16 @@ class Van:
         return self._send_one_inner(target, msg)
 
     def _send_one_inner(self, target: int, msg: Message) -> int:
+        # register for retransmission before the wire attempt so even a
+        # failed first send is retried by the monitor (reference:
+        # resender.h:36 AddOutgoing). sig==0 means not-yet-registered;
+        # ACKs and pre-rendezvous sends (no id to route the ACK back to)
+        # stay outside the protocol.
+        if (self._resender is not None and msg.meta.msg_sig == 0
+                and msg.meta.control_cmd != Control.ACK
+                and self.my_id >= 0 and target != self.my_id):
+            self._resender.assign_sig(msg)
+            self._resender.add_outgoing(target, msg)
         buf = msg.pack()
         if self._native is not None:
             addr = self.node_table.get(target)
@@ -525,6 +549,28 @@ class Van:
             pass
 
     def _process(self, msg: Message) -> None:
+        r = self._resender
+        if r is not None:
+            if msg.meta.control_cmd == Control.ACK:
+                r.handle_ack(msg.meta.msg_sig)
+                return
+            if msg.meta.msg_sig:
+                if r.is_duplicate(msg.meta.msg_sig):
+                    # our previous ACK may have been lost: re-ACK, drop
+                    r.send_ack(msg)
+                    return
+        self._process_inner(msg)
+        # mark-seen + ACK only after a successful dispatch: a handler that
+        # raised gets re-driven by the sender's retransmit (at-least-once).
+        # Caveat: TS control messages are dispatched to a queue, so for
+        # them "successful dispatch" means enqueued — a TS handler that
+        # later raises is logged, not re-driven (TS matchmaking re-asks
+        # periodically, so a lost reply self-heals).
+        if r is not None and msg.meta.msg_sig:
+            r.mark_seen(msg.meta.msg_sig)
+            r.send_ack(msg)
+
+    def _process_inner(self, msg: Message) -> None:
         cmd = msg.meta.control_cmd
         if cmd in (Control.ADD_NODE, Control.ADD_GLOBAL_NODE):
             self._process_add_node(msg)
@@ -657,7 +703,10 @@ class Van:
         for n in all_nodes:
             if n.role == Role.SCHEDULER:
                 continue
-            m = Message(meta=dataclasses.replace(bcast.meta, recver=n.id), data=[])
+            # sender must be stamped here (send() normally does it): the
+            # resender routes members' ACKs back to meta.sender
+            m = Message(meta=dataclasses.replace(
+                bcast.meta, recver=n.id, sender=self.my_id), data=[])
             try:
                 self._send_one(n.id, m)
             except OSError as e:

@@ -203,11 +203,11 @@ class Transport {
       std::lock_guard<std::mutex> lk(queue_mu_);
       queue_cv_.notify_all();
     }
-    if (listener_ >= 0) {
-      ::shutdown(listener_, SHUT_RDWR);
-      ::close(listener_);
-    }
+    if (listener_ >= 0) ::shutdown(listener_, SHUT_RDWR);
     if (accept_thread_.joinable()) accept_thread_.join();
+    // close only after the join: closing first frees the fd number for
+    // reuse while the accept thread may still be entering ::accept on it
+    if (listener_ >= 0) ::close(listener_);
     // no new readers can appear past this point
     {
       std::lock_guard<std::mutex> lk(readers_mu_);
@@ -343,11 +343,13 @@ class Transport {
         return -1;
     }
     if (queue_.empty()) return stopped_.load() ? -2 : -1;
+    // allocate before dequeuing so an allocation failure doesn't lose
+    // the frame — the caller can retry
+    uint8_t* buf = static_cast<uint8_t*>(::malloc(queue_.front().size()));
+    if (!buf) return -3;
     std::string frame = std::move(queue_.front());
     queue_.pop_front();
     lk.unlock();
-    uint8_t* buf = static_cast<uint8_t*>(::malloc(frame.size()));
-    if (!buf) return -3;
     std::memcpy(buf, frame.data(), frame.size());
     *out = buf;
     return int64_t(frame.size());
