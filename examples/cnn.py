@@ -39,6 +39,9 @@ def main():
     parser.add_argument("-c", "--cpu", action="store_true")
     parser.add_argument("--max-iters", type=int, default=0,
                         help="stop after N iterations (0 = full epochs)")
+    parser.add_argument("--checkpoint-prefix", type=str, default="",
+                        help="save params each epoch; resume from the "
+                             "latest epoch if one exists")
     args = parser.parse_args()
 
     if args.cpu:
@@ -66,6 +69,21 @@ def main():
     leaves, _treedef, grad_step, eval_step = build_model_and_step(
         args.batch_size)
 
+    start_epoch = 0
+    resume_iters = 0
+    if args.checkpoint_prefix:
+        from geomx_tpu import checkpoint as gx_ckpt
+
+        latest = gx_ckpt.latest_checkpoint(args.checkpoint_prefix)
+        if latest is not None:
+            saved, _, meta = gx_ckpt.load_checkpoint(
+                args.checkpoint_prefix, latest)
+            leaves = [np.asarray(l) for l in saved]
+            start_epoch = latest
+            resume_iters = int(meta.get("iters", 0))
+            print(f"Resumed from {args.checkpoint_prefix}-{latest:04d}.ckpt "
+                  f"(epoch {latest}, iter {resume_iters}).")
+
     for idx, leaf in enumerate(leaves):
         kv.init(idx, leaf)
         if kv.is_master_worker:
@@ -81,10 +99,10 @@ def main():
         split_by_class=args.split_by_class)
 
     begin_time = time.time()
-    global_iters = 1
+    global_iters = resume_iters + 1 if args.checkpoint_prefix else 1
     measure = Measure(sub_dir=f"cnn_rank{my_rank}")
     print(f"Start training on {num_all_workers} workers, my rank is {my_rank}.")
-    for epoch in range(args.epoch):
+    for epoch in range(start_epoch, args.epoch):
         for X, y in train_iter:
             loss, grads = grad_step([jnp.asarray(l) for l in leaves],
                                     jnp.asarray(X), jnp.asarray(y))
@@ -101,6 +119,12 @@ def main():
                 measure.dump()
                 return
             global_iters += 1
+        if args.checkpoint_prefix and my_rank == 0:
+            from geomx_tpu import checkpoint as gx_ckpt
+
+            gx_ckpt.save_checkpoint(args.checkpoint_prefix, epoch + 1,
+                                    [np.asarray(l) for l in leaves],
+                                    metadata={"iters": global_iters - 1})
     measure.dump()
 
 

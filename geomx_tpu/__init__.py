@@ -22,11 +22,14 @@ Reference call-outs in docstrings cite files under ``/root/reference``
 
 __version__ = "0.1.0"
 
+from geomx_tpu import checkpoint  # noqa: F401
 from geomx_tpu import config  # noqa: F401
 from geomx_tpu import kvstore as kv  # noqa: F401  (mirrors mx.kv)
+from geomx_tpu import metric  # noqa: F401  (mirrors mx.metric)
 from geomx_tpu import optimizer  # noqa: F401
 from geomx_tpu import profiler  # noqa: F401  (mirrors mx.profiler)
 from geomx_tpu.kvstore import create  # noqa: F401
+from geomx_tpu.trainer import Trainer  # noqa: F401
 
 # Mirror reference bootstrap: `import mxnet` on a node whose DMLC role is an
 # infrastructure role (scheduler / server / global_scheduler / global_server)

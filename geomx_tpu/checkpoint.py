@@ -1,0 +1,148 @@
+"""Checkpoint / resume.
+
+The reference offers file-based checkpointing on the worker side only:
+``model.py:383 save_checkpoint`` / ``:413 load_checkpoint`` (symbol+params),
+``module/module.py:165 save_checkpoint`` (+ optimizer states at 791/807),
+and kvstore updater-state dump/load (``python/mxnet/kvstore.py:566/582``).
+Server-side state is never persisted; resume re-initializes and relies on
+the recovery protocol. This module reproduces that surface for pytrees of
+JAX/numpy arrays, serialized with flax's msgpack codec, written atomically
+(tmp + rename) so a crash mid-write can't corrupt the latest checkpoint.
+
+Naming follows the reference: ``{prefix}-{epoch:04d}.ckpt``.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Any, Dict, Optional, Tuple
+
+from flax import serialization
+
+__all__ = [
+    "save_checkpoint", "load_checkpoint", "latest_checkpoint",
+    "save_optimizer_states", "load_optimizer_states",
+]
+
+
+def _ckpt_path(prefix: str, epoch: int) -> str:
+    return f"{prefix}-{epoch:04d}.ckpt"
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = f"{path}.{os.getpid()}.tmp"
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def _writable(tree: Any) -> Any:
+    """Deep-copy restored arrays: msgpack_restore yields read-only views
+    over the file buffer, but optimizer states are updated in place."""
+    import numpy as np
+
+    if isinstance(tree, dict):
+        return {k: _writable(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        t = [_writable(v) for v in tree]
+        return t if isinstance(tree, list) else tuple(t)
+    if isinstance(tree, np.ndarray):
+        return np.array(tree)
+    return tree
+
+
+def save_checkpoint(prefix: str, epoch: int, params: Any,
+                    optimizer_states: Any = None,
+                    metadata: Optional[Dict[str, Any]] = None) -> str:
+    """Persist a training snapshot; returns the written path.
+
+    ``params`` is any pytree of arrays (a flax params dict, a list of
+    leaves, ...). ``optimizer_states`` is whatever the optimizer's
+    ``get_states()`` returned (arrays/dicts/scalars). ``metadata`` is a
+    small JSON-like dict (iteration counters, rng seeds, ...).
+    """
+    payload = {
+        "params": params,
+        "optimizer_states": optimizer_states,
+        "metadata": metadata or {},
+        "epoch": epoch,
+    }
+    path = _ckpt_path(prefix, epoch)
+    _atomic_write(path, serialization.msgpack_serialize(payload))
+    return path
+
+
+def load_checkpoint(prefix: str, epoch: int) -> Tuple[Any, Any, Dict]:
+    """Load ``(params, optimizer_states, metadata)`` for an epoch."""
+    with open(_ckpt_path(prefix, epoch), "rb") as f:
+        payload = _writable(serialization.msgpack_restore(f.read()))
+    return (payload["params"], payload.get("optimizer_states"),
+            payload.get("metadata", {}))
+
+
+def latest_checkpoint(prefix: str) -> Optional[int]:
+    """Highest epoch with a checkpoint under ``prefix``, or None."""
+    pat = re.compile(re.escape(os.path.basename(prefix)) + r"-(\d{4})\.ckpt$")
+    best = None
+    for p in glob.glob(f"{prefix}-*.ckpt"):
+        m = pat.search(os.path.basename(p))
+        if m:
+            e = int(m.group(1))
+            best = e if best is None else max(best, e)
+    return best
+
+
+def _delist_tuples(tree: Any) -> Any:
+    """msgpack (strict_types) rejects tuples; turn them into lists."""
+    if isinstance(tree, dict):
+        return {k: _delist_tuples(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return [_delist_tuples(v) for v in tree]
+    return tree
+
+
+def _encode_key(k: Any) -> Any:
+    """State keys may be ints or (key, offset) shard tuples; tag them."""
+    if isinstance(k, tuple):
+        return ["t", [int(x) for x in k]]
+    return ["i", int(k)]
+
+
+def _decode_key(e: Any) -> Any:
+    tag, v = e
+    return tuple(int(x) for x in v) if tag == "t" else int(v)
+
+
+def serialize_states(states: Dict) -> bytes:
+    """Key->state dict to bytes. msgpack maps need string keys and refuse
+    tuples, so encode as a pair-list with tagged keys."""
+    return serialization.msgpack_serialize(
+        [[_encode_key(k), _delist_tuples(v)] for k, v in states.items()])
+
+
+def deserialize_states(data: bytes) -> Dict:
+    pairs = _writable(serialization.msgpack_restore(data))
+    return {_decode_key(k): v for k, v in pairs}
+
+
+def save_optimizer_states(fname: str, optimizer) -> None:
+    """Dump an optimizer's states to file (reference: kvstore.py:566).
+
+    States are keyed by kv key (int); msgpack maps are restored with
+    string keys only, so persist as a pair-list.
+    """
+    _atomic_write(fname, serialize_states(optimizer.get_states()))
+
+
+def load_optimizer_states(fname: str, optimizer) -> None:
+    """Restore an optimizer's states from file (reference: kvstore.py:582)."""
+    with open(fname, "rb") as f:
+        optimizer.set_states(deserialize_states(f.read()))

@@ -30,6 +30,8 @@ class Command:
     SET_PROFILER_PARAMS = 6
     SET_MULTI_PRECISION = 7
     GLOBAL_BARRIER = 8            # cross-party worker barrier (via servers)
+    GET_OPTIMIZER_STATES = 9      # fetch the server-side updater's states
+    SET_OPTIMIZER_STATES = 10     # restore the server-side updater's states
 
 
 # Data-plane cmd values carried in push meta.head.
@@ -104,6 +106,30 @@ class KVStore:
 
     def set_gradient_compression(self, compression_params: Dict) -> None:
         self._compression_params = dict(compression_params)
+
+    # -- optimizer state persistence (reference: kvstore.py:566/582) -----
+
+    def save_optimizer_states(self, fname: str) -> None:
+        """Dump the updater's states (reference: kvstore.py:566). This base
+        implementation serves stores whose updater runs in-process
+        (KVStoreLocal); KVStoreDist overrides it with a server round-trip
+        because the live states sit on the aggregation server."""
+        opt = getattr(self, "_optimizer", None)
+        if opt is None:
+            raise RuntimeError("no optimizer set on this node; "
+                               "save_optimizer_states must run where "
+                               "set_optimizer was called")
+        from geomx_tpu import checkpoint
+
+        checkpoint.save_optimizer_states(fname, opt)
+
+    def load_optimizer_states(self, fname: str) -> None:
+        opt = getattr(self, "_optimizer", None)
+        if opt is None:
+            raise RuntimeError("no optimizer set on this node")
+        from geomx_tpu import checkpoint
+
+        checkpoint.load_optimizer_states(fname, opt)
 
     def barrier(self, is_global: bool = False) -> None:
         pass

@@ -349,6 +349,7 @@ class KVStoreDist(KVStore):
                 "set_optimizer must run on the master worker in HiPS mode"
         else:
             assert self.rank == 0, "set_optimizer must run on rank 0"
+        self._optimizer = optimizer  # kept for save_optimizer_states
         body = pickle.dumps(optimizer).hex()
         self._send_command(Command.CONTROLLER, body)
 
@@ -358,6 +359,33 @@ class KVStoreDist(KVStore):
             import json
             self._send_command(Command.SET_GRADIENT_COMPRESSION,
                                json.dumps(self._compression_params))
+
+    # -- optimizer state persistence (reference: kvstore.py:566/582) -----
+    # In HiPS the LIVE optimizer states live on the server that applies
+    # updates (its unpickled updater copy), not on this worker — so dump/
+    # restore is a command round-trip. States are kept per-server (keyed
+    # by server rank) because sharded keys have independent per-shard
+    # states on each server.
+
+    def save_optimizer_states(self, fname: str) -> None:
+        import json
+
+        from geomx_tpu import checkpoint
+
+        ts = self.kvw.request(Command.GET_OPTIMIZER_STATES, "",
+                              psbase.SERVER_GROUP)
+        self.kvw.wait(ts, 120.0)
+        per_server: Dict[str, str] = {}
+        for body in self.kvw.take_response_bodies(ts):
+            d = json.loads(body)
+            per_server[str(d["rank"])] = d["states"]
+        checkpoint._atomic_write(
+            fname, json.dumps(per_server).encode())
+
+    def load_optimizer_states(self, fname: str) -> None:
+        with open(fname, "rb") as f:
+            body = f.read().decode()
+        self._send_command(Command.SET_OPTIMIZER_STATES, body)
 
     def set_profiler_params(self, cmd: int, **params) -> None:
         """Remotely drive the SERVER-side profilers (reference:

@@ -825,6 +825,28 @@ class KVStoreDistServer:
         if head == Command.GLOBAL_BARRIER:
             self._handle_global_barrier(req, srv)
             return
+        if head == Command.GET_OPTIMIZER_STATES:
+            # the LIVE optimizer states are here (this server's unpickled
+            # updater copy) — ship them back keyed by our shard rank
+            from geomx_tpu import checkpoint
+
+            states = (self.updater.get_states()
+                      if self.updater is not None else {})
+            srv.response(req, body=json.dumps({
+                "rank": self.po_local.my_rank,
+                "states": checkpoint.serialize_states(states).hex(),
+            }))
+            return
+        if head == Command.SET_OPTIMIZER_STATES:
+            from geomx_tpu import checkpoint
+
+            per_server = json.loads(body)
+            mine = per_server.get(str(self.po_local.my_rank))
+            if mine is not None and self.updater is not None:
+                self.updater.set_states(
+                    checkpoint.deserialize_states(bytes.fromhex(mine)))
+            srv.response(req)
+            return
         if head == Command.SYNC_MODE:
             self.sync_mode = body != "0"
         elif head == Command.SYNC_GLOBAL_MODE:
@@ -848,9 +870,14 @@ class KVStoreDistServer:
             uid = (self.po_global.my_id if self.po_global is not None
                    else self.po_local.my_rank)
             profiler.apply_remote_command(body, uid)
-        srv.response(req)
+        # rebroadcast BEFORE responding: the master's set_* call returning
+        # must establish a happens-before with every server having applied
+        # the config — otherwise a worker push racing the (previously
+        # fire-and-forget) rebroadcast reaches a party server still running
+        # the old config (e.g. BSC pushes handled uncompressed)
         if not global_tier:
             self._rebroadcast_command(head, body)
+        srv.response(req)
 
     def _handle_global_barrier(self, req: ReqMeta, srv: KVServer) -> None:
         """Cross-party worker barrier: when all local workers arrived, this
@@ -872,26 +899,33 @@ class KVStoreDistServer:
             s.response(r)
 
     def _rebroadcast_command(self, head: int, body: str) -> None:
-        """A global server re-broadcasts config commands to its peers
-        (reference: kvstore_dist_server.h:311-318)."""
+        """A global server re-broadcasts config commands to its peers and
+        waits for their acks (reference fire-and-forgets,
+        kvstore_dist_server.h:311-318 — we wait so the master's set_* call
+        returning means the whole cluster runs the new config)."""
         if not self.is_global_server or self.po_global is None:
             return
         if head not in (Command.CONTROLLER, Command.SET_GRADIENT_COMPRESSION,
-                        Command.SYNC_GLOBAL_MODE,
-                        Command.SET_PROFILER_PARAMS):
+                        Command.SYNC_GLOBAL_MODE, Command.SET_PROFILER_PARAMS,
+                        Command.SET_OPTIMIZER_STATES):
             return
+        if self._cmd_kvw is None:
+            self._cmd_kvw = KVWorker(self.po_global, customer_id=2)
         # both tiers: other global servers + party servers (global workers)
         targets = [psbase.server_rank_to_id(r)
                    for r in range(self.po_global.num_servers)]
         targets += [psbase.worker_rank_to_id(r)
                     for r in range(self.po_global.num_workers)]
+        tss = []
         for nid in targets:
             if nid == self.po_global.my_id:
                 continue
-            self.po_global.van.send(Message(Meta(
-                recver=nid, app_id=0, customer_id=0, timestamp=-1,
-                request=True, simple_app=True, head=head, body=body,
-            )))
+            tss.append(self._cmd_kvw.request(head, body, nid))
+        for ts in tss:
+            try:
+                self._cmd_kvw.wait(ts, 60.0)
+            except TimeoutError:
+                log.warning("command %d rebroadcast ack timed out", head)
 
     def _cascade_stop(self) -> None:
         """Every party server forwards StopServer to the global servers,

@@ -150,6 +150,7 @@ class KVWorker:
         self._lock = threading.Lock()
         # ts -> list of response KVPairs
         self._responses: Dict[int, List[KVPairs]] = {}
+        self._response_bodies: Dict[int, List[str]] = {}
         self._callbacks: Dict[int, Callable[[], None]] = {}
 
     # -- public API ------------------------------------------------------
@@ -268,6 +269,10 @@ class KVWorker:
         with self._lock:
             return self._responses.pop(ts, [])
 
+    def take_response_bodies(self, ts: int) -> List[str]:
+        with self._lock:
+            return self._response_bodies.pop(ts, [])
+
     # -- inbound ---------------------------------------------------------
 
     def _process(self, msg: Message) -> None:
@@ -282,6 +287,10 @@ class KVWorker:
             kvs = _unpack_kv(msg)
             with self._lock:
                 self._responses.setdefault(ts, []).append(kvs)
+        if msg.meta.simple_app and msg.meta.body:
+            # command responses may carry a payload (e.g. optimizer states)
+            with self._lock:
+                self._response_bodies.setdefault(ts, []).append(msg.meta.body)
         with self._lock:
             cb = self._callbacks.pop(ts, None)
         if cb is not None:
@@ -293,8 +302,9 @@ class KVWorker:
         """TSEngine worker-to-worker relay receive (kvstore_dist.h:58)."""
         self._request_handle = fn
 
-    def response(self, req: ReqMeta, kvs: Optional[KVPairs] = None) -> None:
-        _send_response(self.po, self.customer, req, kvs)
+    def response(self, req: ReqMeta, kvs: Optional[KVPairs] = None,
+                 body: str = "") -> None:
+        _send_response(self.po, self.customer, req, kvs, body)
 
     def stop(self) -> None:
         self.po.deregister_customer(self.customer)
@@ -320,8 +330,9 @@ class KVServer:
             return
         self._request_handle(_req_meta_of(msg), _unpack_kv(msg), self)
 
-    def response(self, req: ReqMeta, kvs: Optional[KVPairs] = None) -> None:
-        _send_response(self.po, self.customer, req, kvs)
+    def response(self, req: ReqMeta, kvs: Optional[KVPairs] = None,
+                 body: str = "") -> None:
+        _send_response(self.po, self.customer, req, kvs, body)
 
     def stop(self) -> None:
         self.po.deregister_customer(self.customer)
@@ -348,7 +359,8 @@ def _req_meta_of(msg: Message) -> ReqMeta:
 
 
 def _send_response(
-    po: Postoffice, customer: Customer, req: ReqMeta, kvs: Optional[KVPairs]
+    po: Postoffice, customer: Customer, req: ReqMeta,
+    kvs: Optional[KVPairs], body: str = "",
 ) -> None:
     meta = Meta(
         recver=req.sender,
@@ -360,6 +372,7 @@ def _send_response(
         pull=req.pull,
         simple_app=req.simple_app,
         head=req.head,
+        body=body,
     )
     if kvs is not None:
         msg = _pack_kv(meta, kvs)

@@ -8,10 +8,13 @@ it has already accepted; a monitor thread re-sends messages whose ACK has
 not arrived within ``PS_RESEND_TIMEOUT`` milliseconds.
 
 Deltas from the reference, on purpose:
-- signatures are a per-van nonce (node id + counter) instead of a content
-  hash — collision-free and cheaper than hashing tensor payloads;
-- the receiver ACKs *after* the message was dispatched without raising, so
-  retransmits re-drive a handler that failed (at-least-once semantics);
+- signatures are a per-van nonce (node id + clock-seeded counter) instead
+  of a content hash — collision-free and cheaper than hashing payloads;
+- the receiver ACKs after the message was *delivered* without raising —
+  for control messages that means handled, for data/TS messages it means
+  enqueued to the app/TS dispatch queue (the same guarantee ps-lite gives:
+  ACK confirms transport delivery, not application success; handler
+  exceptions are logged by the dispatch loops);
 - retries are capped (``max_retries``, default 10) so a permanently dead
   peer cannot accumulate an unbounded resend queue — the reference leans
   on heartbeat-based dead-node eviction for that instead.
@@ -55,9 +58,12 @@ class Resender:
         self._seen_order: Deque[int] = deque()
         # seed the counter from the wall clock so a recovered node (same
         # id, fresh Resender) never reuses an old incarnation's signatures
-        # — peers' dedup windows would silently swallow the new messages
+        # — peers' dedup windows would silently swallow the new messages.
+        # 16ns ticks: the clock outruns any plausible send rate (a node
+        # would need a sustained 62M msg/s for its counter to catch the
+        # next incarnation's seed); 48-bit space wraps only after ~52 days
         self._counter = itertools.count(
-            (time.time_ns() >> 16) & ((1 << 43) - 1))
+            (time.time_ns() >> 4) & ((1 << 48) - 1))
         self._stopped = threading.Event()
         self._thread = threading.Thread(
             target=self._monitor, name="van-resend", daemon=True)
@@ -69,7 +75,8 @@ class Resender:
 
     def assign_sig(self, msg: Message) -> int:
         """Unique signature: node id in the high bits, counter in the low."""
-        sig = ((self.van.my_id & 0xFFFF) << 44) | next(self._counter)
+        sig = ((self.van.my_id & 0x7FFF) << 48) | (
+            next(self._counter) & ((1 << 48) - 1))
         msg.meta.msg_sig = sig
         return sig
 

@@ -560,12 +560,11 @@ class Van:
                     r.send_ack(msg)
                     return
         self._process_inner(msg)
-        # mark-seen + ACK only after a successful dispatch: a handler that
-        # raised gets re-driven by the sender's retransmit (at-least-once).
-        # Caveat: TS control messages are dispatched to a queue, so for
-        # them "successful dispatch" means enqueued — a TS handler that
-        # later raises is logged, not re-driven (TS matchmaking re-asks
-        # periodically, so a lost reply self-heals).
+        # mark-seen + ACK after successful *delivery*: for control
+        # messages that means handled inline; for data and TS messages it
+        # means enqueued to their dispatch queue (customer/TS loops log
+        # handler exceptions) — the ACK confirms transport delivery, the
+        # same guarantee the reference's resender provides.
         if r is not None and msg.meta.msg_sig:
             r.mark_seen(msg.meta.msg_sig)
             r.send_ack(msg)

@@ -1,0 +1,84 @@
+"""Trainer: parameter/optimizer/kvstore wiring for the worker loop.
+
+Plays the role of gluon's ``Trainer`` (reference:
+python/mxnet/gluon/trainer.py:27 — holds the parameter list, owns the
+kvstore interaction, ``step()`` applies one update) adapted to the JAX
+flow: the model's parameters live as a flat list of leaves whose index is
+the kv key, gradients come out of a jitted ``value_and_grad`` step, and
+the optimizer itself runs on the global aggregation server (set once by
+the master worker via ``kv.set_optimizer``; reference kvstore.py:452).
+
+Usage (see examples/cnn.py for the manual version this wraps):
+
+    leaves, treedef = jax.tree.flatten(params)
+    trainer = Trainer(leaves, kv)       # kv.init + initial pull
+    ...
+    loss, grads = grad_step(trainer.leaves, X, y)
+    trainer.step(grads)                 # push grads, pull fresh params
+
+Checkpointing: ``save(prefix, epoch)`` / ``Trainer.load`` persist the
+leaves (and through ``kv.save_optimizer_states`` the updater state when
+the optimizer is local) — reference: module/module.py:165/791.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from geomx_tpu import checkpoint as ckpt_mod
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params: Sequence[Any], kvstore,
+                 begin_key: int = 0, priority_descending: bool = True):
+        """``params``: list of array leaves; key of leaf i = begin_key+i.
+
+        ``priority_descending`` pushes earlier (closer-to-output in the
+        usual flatten order) keys at higher priority, matching the
+        examples' ``priority=-idx`` P3 pattern.
+        """
+        self.kv = kvstore
+        self.begin_key = begin_key
+        self.priority_descending = priority_descending
+        self.leaves: List[np.ndarray] = [np.asarray(p) for p in params]
+        for i, leaf in enumerate(self.leaves):
+            self.kv.init(begin_key + i, leaf)
+        if not getattr(self.kv, "is_master_worker", False):
+            for i in range(len(self.leaves)):
+                self.kv.pull(begin_key + i, out=self.leaves[i])
+        self.kv.wait()
+
+    # -- one update ------------------------------------------------------
+
+    def step(self, grads: Sequence[Any], pull: bool = True) -> None:
+        """Push per-leaf gradients; pull back the updated parameters."""
+        assert len(grads) == len(self.leaves), (
+            f"got {len(grads)} grads for {len(self.leaves)} params")
+        for i, g in enumerate(grads):
+            prio = -i if self.priority_descending else 0
+            key = self.begin_key + i
+            self.kv.push(key, np.asarray(g), priority=prio)
+            if pull:
+                self.kv.pull(key, out=self.leaves[i], priority=prio)
+        self.kv.wait()
+
+    def pull_all(self) -> None:
+        for i in range(len(self.leaves)):
+            self.kv.pull(self.begin_key + i, out=self.leaves[i])
+        self.kv.wait()
+
+    # -- checkpoint ------------------------------------------------------
+
+    def save(self, prefix: str, epoch: int,
+             metadata: Optional[dict] = None) -> str:
+        return ckpt_mod.save_checkpoint(prefix, epoch, list(self.leaves),
+                                        metadata=metadata)
+
+    @staticmethod
+    def load(prefix: str, epoch: int, kvstore, **kw) -> "Trainer":
+        params, _opt, _meta = ckpt_mod.load_checkpoint(prefix, epoch)
+        return Trainer(params, kvstore, **kw)
