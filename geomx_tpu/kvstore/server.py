@@ -169,6 +169,10 @@ class KVStoreDistServer:
         self.server_local: Optional[KVServer] = None
         self.server_global: Optional[KVServer] = None
         self.worker_global: Optional[KVWorker] = None
+        # lazily-created command-rebroadcast client (customer_id=2); must be
+        # initialized here — reading it uninitialized in the handler thread
+        # swallows the ack and deadlocks every kv.create (round-1 regression)
+        self._cmd_kvw: Optional[KVWorker] = None
 
         # TSEngine endpoints (reference: ENABLE_INTRA_TS / ENABLE_INTER_TS)
         self.ts_local = None     # model dissemination to local workers
@@ -847,6 +851,22 @@ class KVStoreDistServer:
                     checkpoint.deserialize_states(bytes.fromhex(mine)))
             srv.response(req)
             return
+        # apply + rebroadcast BEFORE responding: the master's set_* call
+        # returning must establish a happens-before with every server having
+        # applied the config — otherwise a worker push racing a
+        # fire-and-forget rebroadcast reaches a party server still running
+        # the old config (e.g. BSC pushes handled uncompressed)
+        try:
+            self._apply_config_command(head, body)
+            if not global_tier:
+                self._rebroadcast_command(head, body)
+        finally:
+            # the ack must go out even if applying or rebroadcasting the
+            # command fails — an unacked command blocks the master worker
+            # forever (dist.py wait)
+            srv.response(req)
+
+    def _apply_config_command(self, head: int, body: str) -> None:
         if head == Command.SYNC_MODE:
             self.sync_mode = body != "0"
         elif head == Command.SYNC_GLOBAL_MODE:
@@ -870,14 +890,6 @@ class KVStoreDistServer:
             uid = (self.po_global.my_id if self.po_global is not None
                    else self.po_local.my_rank)
             profiler.apply_remote_command(body, uid)
-        # rebroadcast BEFORE responding: the master's set_* call returning
-        # must establish a happens-before with every server having applied
-        # the config — otherwise a worker push racing the (previously
-        # fire-and-forget) rebroadcast reaches a party server still running
-        # the old config (e.g. BSC pushes handled uncompressed)
-        if not global_tier:
-            self._rebroadcast_command(head, body)
-        srv.response(req)
 
     def _handle_global_barrier(self, req: ReqMeta, srv: KVServer) -> None:
         """Cross-party worker barrier: when all local workers arrived, this
