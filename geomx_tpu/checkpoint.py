@@ -90,7 +90,9 @@ def load_checkpoint(prefix: str, epoch: int) -> Tuple[Any, Any, Dict]:
 
 def latest_checkpoint(prefix: str) -> Optional[int]:
     """Highest epoch with a checkpoint under ``prefix``, or None."""
-    pat = re.compile(re.escape(os.path.basename(prefix)) + r"-(\d{4})\.ckpt$")
+    # {4,}: ``{epoch:04d}`` zero-pads to at least 4 digits but epochs
+    # >= 10000 render wider — a fixed {4} would miss them
+    pat = re.compile(re.escape(os.path.basename(prefix)) + r"-(\d{4,})\.ckpt$")
     best = None
     for p in glob.glob(f"{prefix}-*.ckpt"):
         m = pat.search(os.path.basename(p))

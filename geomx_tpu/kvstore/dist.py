@@ -82,7 +82,7 @@ class KVStoreDist(KVStore):
             self._ts = TSNode(self.po, self.kvw,
                               tgt_merge=self.po.num_workers,
                               final_push=self._ts_final_push)
-            self._ts.on_push_sent = lambda _k, _o, _v: self._untrack()
+            self._ts.on_push_sent = lambda _k, _o, _v: self._untrack(_k)
             self.kvw.set_request_handle(
                 lambda req, kvs, app: self._ts.handle_request(req, kvs, app))
 
@@ -94,6 +94,12 @@ class KVStoreDist(KVStore):
         self._push_acks_left: Dict[int, int] = {}
         self._deferred: Dict[int, List] = {}
         self._outstanding = 0
+        # per-key outstanding op count so wait(keys=[...]) can drain a
+        # subset (reference per-key semantics, kvstore.h WaitToRead on the
+        # key's comm_buf; round-2 Weak #8: keys was silently ignored)
+        self._outstanding_key: Dict[int, int] = {}
+        # transport give-ups recorded by callbacks; surfaced by wait()
+        self._transport_errors: List[str] = []
 
         # startup barrier (reference: kvstore_dist.h:64), then the
         # creation-time command protocol (reference: kvstore.cc:56-63)
@@ -152,15 +158,21 @@ class KVStoreDist(KVStore):
                 v.size, v.shape, v.dtype, self._shards(key, v.size))
         return self._key_info[key]
 
-    def _track(self, n: int = 1) -> None:
+    def _track(self, n: int = 1, key: Optional[int] = None) -> None:
         with self._cv:
             self._outstanding += n
+            if key is not None:
+                self._outstanding_key[key] = (
+                    self._outstanding_key.get(key, 0) + n)
 
-    def _untrack(self) -> None:
+    def _untrack(self, key: Optional[int] = None) -> None:
         with self._cv:
             self._outstanding -= 1
-            if self._outstanding <= 0:
-                self._cv.notify_all()
+            if key is not None and key in self._outstanding_key:
+                self._outstanding_key[key] -= 1
+                if self._outstanding_key[key] <= 0:
+                    del self._outstanding_key[key]
+            self._cv.notify_all()
 
     # -- data plane ------------------------------------------------------
 
@@ -196,20 +208,20 @@ class KVStoreDist(KVStore):
                 # TSEngine: contribute to the reduction overlay; the last
                 # holder pushes the merged gradient for everyone
                 ver = self._ts_ver[k] = self._ts_ver.get(k, 0) + 1
-                self._track(1)
+                self._track(1, k)
                 self._ts.contribute(k, 0, info.total, flat, ver)
                 continue
             with self._lock:
                 self._push_acks_left[k] = (
                     self._push_acks_left.get(k, 0) + len(info.shards))
-            self._track(len(info.shards))
+            self._track(len(info.shards), k)
             for sh in info.shards:
                 kvs = KVPairs(keys=[k],
                               vals=[flat[sh.offset:sh.offset + sh.length]],
                               offsets=[sh.offset], totals=[sh.total],
                               lens=[sh.length])
                 self.kvw.push(kvs, sh.server_rank, priority=priority,
-                              cb=lambda _ts, kk=k: self._on_push_ack(kk))
+                              cb=lambda ts, kk=k: self._on_push_ack(kk, ts))
 
     def _ts_final_push(self, key: int, off: int, total: int,
                        arr: np.ndarray, num_merge: int, ver: int) -> None:
@@ -225,7 +237,7 @@ class KVStoreDist(KVStore):
                 remaining[0] -= 1
                 last = remaining[0] == 0
             if last:
-                self._untrack()
+                self._untrack(key)
 
         for sh in info.shards:
             kvs = KVPairs(keys=[key],
@@ -235,13 +247,20 @@ class KVStoreDist(KVStore):
             self.kvw.push(kvs, sh.server_rank, num_merge=num_merge,
                           cb=on_ack)
 
-    def _on_push_ack(self, key: int) -> None:
+    def _on_push_ack(self, key: int, ts: int) -> None:
+        fail = self.kvw.take_failure(ts)
+        if fail is not None:
+            # record and fall through: the ack bookkeeping must still
+            # advance (a wedged counter would hang wait() silently) and
+            # wait() raises the recorded error
+            with self._lock:
+                self._transport_errors.append(f"push key {key}: {fail}")
         ready = []
         with self._lock:
             self._push_acks_left[key] -= 1
             if self._push_acks_left[key] == 0 and key in self._deferred:
                 ready = self._deferred.pop(key)
-        self._untrack()
+        self._untrack(key)
         for fn in ready:
             fn()
 
@@ -284,7 +303,7 @@ class KVStoreDist(KVStore):
         done = threading.Event()
         buf = np.zeros(info.total, dtype=np.float32)
         remaining = [len(info.shards)]
-        self._track()
+        self._track(1, key)
 
         def issue():
             for sh in info.shards:
@@ -294,6 +313,10 @@ class KVStoreDist(KVStore):
                     cb=lambda ts, s=sh: on_data(ts, s))
 
         def on_data(ts: int, sh: sharding.Shard):
+            fail = self.kvw.take_failure(ts)
+            if fail is not None:
+                with self._lock:
+                    self._transport_errors.append(f"pull key {key}: {fail}")
             resps = self.kvw.take_response(ts)
             for kvs in resps:
                 for i, _k in enumerate(kvs.keys):
@@ -311,7 +334,7 @@ class KVStoreDist(KVStore):
                     np.copyto(out, buf.reshape(info.shape)
                               .astype(info.dtype, copy=False))
                 done.set()
-                self._untrack()
+                self._untrack(key)
 
         with self._lock:
             if self._push_acks_left.get(key, 0) > 0:
@@ -329,12 +352,30 @@ class KVStoreDist(KVStore):
         return None
 
     def wait(self, keys=None, timeout: float = 300.0) -> None:
-        """Block until all outstanding pushes/pulls complete (the
-        reference's mx.nd.waitall() moment)."""
-        with self._cv:
-            if not self._cv.wait_for(lambda: self._outstanding <= 0, timeout):
-                raise TimeoutError(
-                    f"wait: {self._outstanding} ops still outstanding")
+        """Block until outstanding pushes/pulls complete. With ``keys``,
+        drain only those keys (reference per-key WaitToRead semantics);
+        without, drain everything (the mx.nd.waitall() moment)."""
+        if keys is not None:
+            klist = self._as_key_list(keys)
+            with self._cv:
+                if not self._cv.wait_for(
+                    lambda: all(self._outstanding_key.get(k, 0) <= 0
+                                for k in klist),
+                    timeout,
+                ):
+                    left = {k: self._outstanding_key.get(k, 0)
+                            for k in klist if self._outstanding_key.get(k, 0)}
+                    raise TimeoutError(f"wait(keys): still outstanding {left}")
+        else:
+            with self._cv:
+                if not self._cv.wait_for(lambda: self._outstanding <= 0,
+                                         timeout):
+                    raise TimeoutError(
+                        f"wait: {self._outstanding} ops still outstanding")
+        with self._lock:
+            errs, self._transport_errors = self._transport_errors, []
+        if errs:
+            raise RuntimeError("transport gave up on " + "; ".join(errs))
 
     waitall = wait
 
@@ -375,10 +416,12 @@ class KVStoreDist(KVStore):
         ts = self.kvw.request(Command.GET_OPTIMIZER_STATES, "",
                               psbase.SERVER_GROUP)
         self.kvw.wait(ts, 120.0)
+        # each local server answers {global_rank: states_hex} — party
+        # servers relay to the global tier (where the live updater runs)
+        # and may return overlapping ranks; merging dedups them
         per_server: Dict[str, str] = {}
         for body in self.kvw.take_response_bodies(ts):
-            d = json.loads(body)
-            per_server[str(d["rank"])] = d["states"]
+            per_server.update(json.loads(body))
         checkpoint._atomic_write(
             fname, json.dumps(per_server).encode())
 

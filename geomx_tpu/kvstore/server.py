@@ -16,7 +16,14 @@ protocol, re-designed for host-side asynchrony without the MXNet engine:
   (kvstore_dist_server.h:1146-1167), and workers do not issue a pull for a
   key until its push ack arrived (the engine-var ordering the reference
   gets from comm_buf_ read/write deps), so a pull always observes fresh
-  parameters;
+  parameters; additionally each forward/pull-back is tagged with a
+  per-(key, offset) CYCLE id — stale global-tier responses (e.g. an
+  init-time pull-back overtaken by a training round) are discarded
+  instead of completing the wrong round — and the outbound aggregate is
+  staged OUTSIDE the weight store, with local pulls buffered while a
+  cycle is in flight, so a stale or mid-round pull is impossible by
+  construction (the reference's store_ dual-use at :519 plus engine
+  ordering only makes it unlikely);
 - init-on-first-push, with a pull-back from the global tier that gates all
   early pulls (kvstore_dist_server.h:1241-1274);
 - HFA milestone-delta logic (kvstore_dist_server.h:988-998, 1327-1346);
@@ -90,21 +97,29 @@ class _KeyState:
     """Per-(key, shard-offset) protocol state (UpdateBuf + store_ entry)."""
 
     __slots__ = (
-        "stored", "milestone", "merged", "push_reqs", "deferred_acks",
-        "pending_pulls", "initialized", "rounds", "offset", "length",
-        "total", "dtype", "elems_received", "init_elems", "fwd_parts",
-        "fwd_expected", "fwd_acks_left", "version", "pre_init_pushes",
-        "central_pushes",
+        "stored", "outbound", "milestone", "merged", "push_reqs",
+        "deferred_acks", "pending_pulls", "initialized", "staging", "rounds",
+        "offset", "length", "total", "dtype", "elems_received", "init_elems",
+        "fwd_parts", "fwd_expected", "fwd_acks_left", "version", "cycle",
+        "pre_init_pushes", "central_pushes",
     )
 
     def __init__(self, offset: int):
         self.stored: Optional[np.ndarray] = None
+        # the aggregate staged for the global tier lives here, NEVER in
+        # `stored` — `stored` always holds parameters, so a pull can never
+        # observe a gradient (the round-1/2 freshness race)
+        self.outbound: Optional[np.ndarray] = None
         self.milestone: Optional[np.ndarray] = None
         self.merged: Optional[np.ndarray] = None
         self.push_reqs: List[Tuple[ReqMeta, KVServer]] = []
         self.deferred_acks: List[Tuple[ReqMeta, KVServer]] = []
         self.pending_pulls: List[Tuple[ReqMeta, KVServer, int, int]] = []
         self.initialized = False
+        # True between a local round completing and its global pull-back
+        # being applied; local pulls buffer while set, making the stale
+        # window impossible rather than rare
+        self.staging = False
         self.rounds = 0
         self.offset = offset
         self.length = 0
@@ -116,6 +131,15 @@ class _KeyState:
         self.fwd_expected = 0
         self.fwd_acks_left = 0
         self.version = 0
+        # id of the CURRENT forward/pull-back cycle. Every global-tier
+        # callback (push ack, pull data, TS model) carries the cycle it was
+        # issued for and is DISCARDED if the state has moved on — a stale
+        # init-time pull-back can otherwise complete a newer training round
+        # and release its deferred acks early (the root cause of the
+        # round-2 flake: init's _global_pull response, buffered at the
+        # global server until the master's init, arrived after this
+        # party's workers had already pushed a full training round)
+        self.cycle = 0
         self.central_pushes = 0
         # gradient pushes that raced ahead of initialization (replayed)
         self.pre_init_pushes: List = []
@@ -181,6 +205,10 @@ class KVStoreDistServer:
         self._ts_kvw_global: Optional[KVWorker] = None
         # party-server: per (key, slice-offset) global round counter
         self._g_rounds: Dict[Tuple[int, int], int] = {}
+        # global-server: party size per global-worker sender, for FSA round
+        # counting + uniformity validation (round-2 Weak #5)
+        self._party_nsrv = 1
+        self._party_nsrv_by_sender: Dict[int, int] = {}
 
     # ------------------------------------------------------------------
     # lifecycle (reference: kvstore_dist.h:237-258 RunServer)
@@ -326,9 +354,13 @@ class KVStoreDistServer:
             if self.has_global_tier:
                 # authoritative params live on the global tier: ack the init,
                 # then pull them back before serving any local pull
-                # (reference: DataPullFromGlobalServersDefault at :1274)
+                # (reference: DataPullFromGlobalServersDefault at :1274).
+                # This is cycle 1; if a training round overtakes it, the
+                # response is discarded by the cycle guard.
+                st.cycle += 1
+                cyc = st.cycle
                 return [lambda: srv.response(req),
-                        lambda: self._global_pull(key, off)]
+                        lambda: self._global_pull(key, off, cyc)]
             st.initialized = True
             return [lambda: srv.response(req)] + self._flush_pulls(st, key)
 
@@ -364,6 +396,7 @@ class KVStoreDistServer:
             st.version += 1
             return ([lambda r=r, s=s: s.response(r)
                      for r, s in self._uniq(reqs)]
+                    + self._flush_pulls(st, key)
                     + self._offer_local(st, key))
 
         if self.use_hfa:
@@ -374,11 +407,16 @@ class KVStoreDistServer:
                 self.po_global.num_workers, 1)
         else:
             payload = st.merged
-        # staging: store_ holds the outbound aggregate until the pull-back
-        # overwrites it with fresh params (reference store_ dual use, :519)
-        st.stored = payload.astype(st.dtype)
+        # stage the outbound aggregate in its OWN slot (`stored` keeps the
+        # last weights; the reference's store_ dual-use at :519 is exactly
+        # what let a pull observe the gradient) and open a new cycle; worker
+        # acks defer until THIS cycle's pull-back lands fresh params
+        st.outbound = payload.astype(st.dtype)
+        st.staging = True
+        st.cycle += 1
+        cyc = st.cycle
         st.deferred_acks = reqs
-        return [lambda: self._forward_to_global(key, off)]
+        return [lambda: self._forward_to_global(key, off, cyc)]
 
     # ------------------------------------------------------------------
     # global store: push (init / FSA aggregate / MixedSync)
@@ -492,9 +530,25 @@ class KVStoreDistServer:
         st.elems_received += sub.size * max(req.num_merge, 1)
         st.push_reqs.append((req, srv))
         if from_global_tier:
-            self._party_nsrv = max(req.party_nsrv, 1)
+            pn = max(req.party_nsrv, 1)
+            prev = self._party_nsrv_by_sender.setdefault(req.sender, pn)
+            if prev != pn:
+                log.error("global worker %d changed party_nsrv %d -> %d "
+                          "mid-run; round counting may be wrong",
+                          req.sender, prev, pn)
+                self._party_nsrv_by_sender[req.sender] = pn
+            if len(set(self._party_nsrv_by_sender.values())) > 1:
+                # the round-completion formula below assumes uniform party
+                # sizes (documented); surface violations loudly instead of
+                # silently mis-counting (round-2 Weak #5)
+                log.error(
+                    "non-uniform party sizes %s: FSA round counting "
+                    "assumes every party runs the same number of local "
+                    "servers — fix the topology",
+                    dict(self._party_nsrv_by_sender))
+            self._party_nsrv = pn
         n_gw = self.po_global.num_workers if self.po_global else 1
-        n_parties = max(n_gw // max(getattr(self, "_party_nsrv", 1), 1), 1)
+        n_parties = max(n_gw // max(self._party_nsrv, 1), 1)
         expected = n_parties
         if self.is_global_server and self.cfg.enable_central_worker:
             expected += self.po_local.num_workers
@@ -531,7 +585,10 @@ class KVStoreDistServer:
 
     def _pull_local_store(self, req, srv, key, off) -> List[Action]:
         st = self._state(key, off)
-        if not st.initialized:
+        if not st.initialized or st.staging:
+            # buffered until the in-flight cycle applies fresh params —
+            # sync-mode pulls must never be served mid-round (reference
+            # buffered-pull semantics, kvstore_dist_server.h:1146-1167)
             st.pending_pulls.append((req, srv, off, 0))
             return []
         return [self._pull_response_action(st, req, srv, key, off, 0, "")]
@@ -603,33 +660,45 @@ class KVStoreDistServer:
     #  :936-950, pull-back assembly :952-1167)
     # ------------------------------------------------------------------
 
-    def _forward_to_global(self, key: int, off: int) -> None:
+    def _forward_to_global(self, key: int, off: int, cycle: int) -> None:
         if self.ts_global is not None and self.sync_global_mode:
-            self._ts_forward_to_global(key, off)
+            self._ts_forward_to_global(key, off, cycle)
             return
         with self._lock:
             st = self._state(key, off)
-            payload = st.stored
+            if st.cycle != cycle:
+                return
             total = st.total
             slices = self._global_slices(key, off, st.length, total)
             st.fwd_acks_left = len(slices)
         for g_rank, lo, hi in slices:
-            sub = np.ascontiguousarray(payload[lo - off:hi - off])
-            wire_val, aux, compr = self.gc.compress_push(sub, (key, lo))
-            kvs = KVPairs(keys=[key], vals=[wire_val], aux=[aux],
-                          offsets=[lo], totals=[total], lens=[hi - lo],
-                          compr=compr)
-            self.worker_global.push(
-                kvs, g_rank, party_nsrv=self.po_local.num_servers,
-                cb=lambda _ts, k=key, o=off: self._on_global_push_ack(k, o))
+            self._push_slice_global(key, off, cycle, g_rank, lo, hi, total)
 
-    def _ts_forward_to_global(self, key: int, off: int) -> None:
+    def _push_slice_global(self, key, off, cycle, g_rank, lo, hi,
+                           total) -> None:
+        with self._lock:
+            st = self._state(key, off)
+            if st.cycle != cycle or st.outbound is None:
+                return
+            sub = np.ascontiguousarray(st.outbound[lo - off:hi - off])
+        wire_val, aux, compr = self.gc.compress_push(sub, (key, lo))
+        kvs = KVPairs(keys=[key], vals=[wire_val], aux=[aux],
+                      offsets=[lo], totals=[total], lens=[hi - lo],
+                      compr=compr)
+        self.worker_global.push(
+            kvs, g_rank, party_nsrv=self.po_local.num_servers,
+            cb=lambda ts, k=key, o=off, c=cycle, g=g_rank, l=lo, h=hi,
+            t=total: self._on_global_push_ack(k, o, c, g, l, h, t, ts))
+
+    def _ts_forward_to_global(self, key: int, off: int, cycle: int) -> None:
         """Inter-TS: contribute each global slice to the overlay (merged
         party-to-party), watch for the disseminated model (reference: the
         TS_Push / AutoPull2 path)."""
         with self._lock:
             st = self._state(key, off)
-            payload = st.stored
+            if st.cycle != cycle:
+                return
+            payload = st.outbound
             total = st.total
             length = st.length
             ranges = sharding.assign(key, total, self.po_global.num_servers,
@@ -650,15 +719,17 @@ class KVStoreDistServer:
             # every global worker — watch the range offset, extract overlap
             self.ts_global.when_model(
                 key, rng.offset, v,
-                lambda k=key, o=off, ro=rng.offset, l=lo, h=hi:
-                    self._on_ts_global_model(k, o, ro, l, h))
+                lambda k=key, o=off, ro=rng.offset, l=lo, h=hi, c=cycle:
+                    self._on_ts_global_model(k, o, ro, l, h, c))
             self.ts_global.contribute(key, lo, total, sub, v)
 
-    def _on_ts_global_model(self, key, off, rng_off, lo, hi) -> None:
+    def _on_ts_global_model(self, key, off, rng_off, lo, hi, cycle) -> None:
         data = self.ts_global.model_of(key, rng_off)
         acts: List[Action] = []
         with self._lock:
             st = self._state(key, off)
+            if st.cycle != cycle:
+                return
             if data is not None:
                 hi2 = min(hi, rng_off + data.size)
                 if hi2 > lo:
@@ -730,35 +801,74 @@ class KVStoreDistServer:
                 out.append((rng.server_rank, lo, hi))
         return out
 
-    def _on_global_push_ack(self, key: int, off: int) -> None:
+    def _on_global_push_ack(self, key, off, cycle, g_rank, lo, hi, total,
+                            ts) -> None:
+        fail = self.worker_global.take_failure(ts)
+        if fail is not None:
+            # the WAN hop gave up (resender retries exhausted). The cycle
+            # must not wedge: retry this slice after a backoff — the peer
+            # may have recovered (recovery re-assigns its id/address); the
+            # cycle guard discards retries of superseded rounds
+            log.error("global push of key %d [%d:%d) undeliverable (%s); "
+                      "retrying in 1s", key, lo, hi, fail)
+            self._retry_later(self._push_slice_global, key, off, cycle,
+                              g_rank, lo, hi, total)
+            return
         issue = False
         with self._lock:
             st = self._state(key, off)
+            if st.cycle != cycle:
+                return
             st.fwd_acks_left -= 1
             if st.fwd_acks_left == 0:
                 issue = True
         if issue:
-            self._global_pull(key, off)
+            self._global_pull(key, off, cycle)
 
-    def _global_pull(self, key: int, off: int) -> None:
+    def _retry_later(self, fn, *args, delay: float = 1.0) -> None:
+        t = threading.Timer(delay, fn, args=args)
+        t.daemon = True
+        t.start()
+
+    def _global_pull(self, key: int, off: int, cycle: int) -> None:
         with self._lock:
             st = self._state(key, off)
+            if st.cycle != cycle:
+                return
             slices = self._global_slices(key, off, st.length, st.total)
             st.fwd_expected = len(slices)
             st.fwd_parts = {}
             total = st.total
         for g_rank, lo, hi in slices:
-            self.worker_global.pull(
-                [key], g_rank, offsets=[lo], totals=[total], lens=[hi - lo],
-                compr=self.gc.pull_compr_tag(hi - lo),
-                cb=lambda ts, k=key, o=off, l=lo, h=hi:
-                    self._on_global_pull_data(k, o, l, h, ts))
+            self._pull_slice_global(key, off, cycle, g_rank, lo, hi, total)
 
-    def _on_global_pull_data(self, key, off, lo, hi, ts) -> None:
+    def _pull_slice_global(self, key, off, cycle, g_rank, lo, hi,
+                           total) -> None:
+        with self._lock:
+            if self._state(key, off).cycle != cycle:
+                return
+        self.worker_global.pull(
+            [key], g_rank, offsets=[lo], totals=[total], lens=[hi - lo],
+            compr=self.gc.pull_compr_tag(hi - lo),
+            cb=lambda ts, k=key, o=off, l=lo, h=hi, c=cycle, g=g_rank,
+            t=total: self._on_global_pull_data(k, o, l, h, ts, c, g, t))
+
+    def _on_global_pull_data(self, key, off, lo, hi, ts, cycle, g_rank,
+                             total) -> None:
+        fail = self.worker_global.take_failure(ts)
+        if fail is not None:
+            log.error("global pull of key %d [%d:%d) undeliverable (%s); "
+                      "retrying in 1s", key, lo, hi, fail)
+            self._retry_later(self._pull_slice_global, key, off, cycle,
+                              g_rank, lo, hi, total)
+            return
+        # drain the tracker even when the cycle guard discards the data
         resps = self.worker_global.take_response(ts)
         acts: List[Action] = []
         with self._lock:
             st = self._state(key, off)
+            if st.cycle != cycle:
+                return
             for kvs in resps:
                 for i, _k in enumerate(kvs.keys):
                     data = np.asarray(kvs.vals[i]).ravel()
@@ -796,6 +906,8 @@ class KVStoreDistServer:
         else:
             st.stored = assembled.astype(st.dtype)
         st.initialized = True
+        st.staging = False
+        st.outbound = None
         st.version += 1
         acks, st.deferred_acks = st.deferred_acks, []
         acts: List[Action] = [lambda r=r, s=s: s.response(r)
@@ -830,22 +942,44 @@ class KVStoreDistServer:
             self._handle_global_barrier(req, srv)
             return
         if head == Command.GET_OPTIMIZER_STATES:
-            # the LIVE optimizer states are here (this server's unpickled
-            # updater copy) — ship them back keyed by our shard rank
+            # the LIVE updater runs where updates apply: the GLOBAL tier in
+            # HiPS (ApplyUpdates gate, reference kvstore_dist_server.h:512),
+            # this server otherwise. A party server answering with its own
+            # never-updated copy was the round-2 advisor finding (a): relay
+            # to the global servers instead and merge their answers.
+            # Response body: JSON {global_server_rank: states_hex, ...}.
+            if (self.has_global_tier and not global_tier
+                    and self.worker_global is not None):
+                srv.response(req, body=json.dumps(
+                    self._relay_optimizer_states_get()))
+                return
             from geomx_tpu import checkpoint
 
             states = (self.updater.get_states()
                       if self.updater is not None else {})
-            srv.response(req, body=json.dumps({
-                "rank": self.po_local.my_rank,
-                "states": checkpoint.serialize_states(states).hex(),
-            }))
+            rank = (self.po_global.my_rank
+                    if self.is_global_server and self.po_global is not None
+                    else self.po_local.my_rank)
+            srv.response(req, body=json.dumps(
+                {str(rank): checkpoint.serialize_states(states).hex()}))
             return
         if head == Command.SET_OPTIMIZER_STATES:
+            if (self.has_global_tier and not global_tier
+                    and self.worker_global is not None):
+                # restore must land on the live (global-tier) updater
+                self._relay_optimizer_states_set(body)
+                srv.response(req)
+                return
             from geomx_tpu import checkpoint
 
             per_server = json.loads(body)
-            mine = per_server.get(str(self.po_local.my_rank))
+            if set(per_server) == {"rank", "states"}:
+                # legacy single-server wire shape ({"rank": r, "states": s})
+                per_server = {str(per_server["rank"]): per_server["states"]}
+            rank = (self.po_global.my_rank
+                    if self.is_global_server and self.po_global is not None
+                    else self.po_local.my_rank)
+            mine = per_server.get(str(rank))
             if mine is not None and self.updater is not None:
                 self.updater.set_states(
                     checkpoint.deserialize_states(bytes.fromhex(mine)))
@@ -910,6 +1044,42 @@ class KVStoreDistServer:
         for r, s in reqs:
             s.response(r)
 
+    def _relay_optimizer_states_get(self) -> Dict[str, str]:
+        """Party server: fetch the live states from every global server
+        and merge them into one {global_rank: states_hex} dict."""
+        merged: Dict[str, str] = {}
+        tss = []
+        for rank in range(self.po_global.num_servers):
+            tss.append(self.worker_global.request(
+                Command.GET_OPTIMIZER_STATES, "",
+                psbase.server_rank_to_id(rank)))
+        for ts in tss:
+            try:
+                self.worker_global.wait(ts, 60.0)
+            except (TimeoutError, RuntimeError) as e:
+                log.warning("optimizer-state fetch from global tier "
+                            "failed: %s", e)
+                continue
+            for resp in self.worker_global.take_response_bodies(ts):
+                merged.update(json.loads(resp))
+        return merged
+
+    def _relay_optimizer_states_set(self, body: str) -> None:
+        """Party server: forward a restore to every global server
+        (idempotent — several party servers may relay the same body).
+        All requests go out before any wait so a slow global server
+        can't push the total past the caller's own timeout."""
+        tss = []
+        for rank in range(self.po_global.num_servers):
+            tss.append(self.worker_global.request(
+                Command.SET_OPTIMIZER_STATES, body,
+                psbase.server_rank_to_id(rank)))
+        for ts in tss:
+            try:
+                self.worker_global.wait(ts, 60.0)
+            except (TimeoutError, RuntimeError) as e:
+                log.warning("optimizer-state restore relay failed: %s", e)
+
     def _rebroadcast_command(self, head: int, body: str) -> None:
         """A global server re-broadcasts config commands to its peers and
         waits for their acks (reference fire-and-forgets,
@@ -917,9 +1087,12 @@ class KVStoreDistServer:
         returning means the whole cluster runs the new config)."""
         if not self.is_global_server or self.po_global is None:
             return
+        # SET_OPTIMIZER_STATES is NOT rebroadcast: the live updaters are
+        # the global servers themselves (all of which the master's local
+        # SERVER_GROUP send already reached); pushing global-rank-keyed
+        # states onto party servers' unused copies would mis-apply them
         if head not in (Command.CONTROLLER, Command.SET_GRADIENT_COMPRESSION,
-                        Command.SYNC_GLOBAL_MODE, Command.SET_PROFILER_PARAMS,
-                        Command.SET_OPTIMIZER_STATES):
+                        Command.SYNC_GLOBAL_MODE, Command.SET_PROFILER_PARAMS):
             return
         if self._cmd_kvw is None:
             self._cmd_kvw = KVWorker(self.po_global, customer_id=2)

@@ -32,6 +32,10 @@ class Customer:
         self._cv = threading.Condition(self._lock)
         # ts -> [num_expected, num_received]
         self._tracker: Dict[int, list] = {}
+        # ts -> failure reason; set by the transport when a request becomes
+        # undeliverable (resender give-up) so wait_request fails fast
+        # instead of blocking to its timeout
+        self._errors: Dict[int, str] = {}
         # callback-driven requests are never wait()ed; auto-drop their
         # tracker entries on completion to avoid unbounded growth
         self._auto_clear: set = set()
@@ -62,11 +66,16 @@ class Customer:
         with self._cv:
             if not self._cv.wait_for(
                 lambda: ts not in self._tracker
-                or self._tracker[ts][1] >= self._tracker[ts][0],
+                or self._tracker[ts][1] >= self._tracker[ts][0]
+                or ts in self._errors,
                 timeout,
             ):
+                self._errors.pop(ts, None)  # no leak on the timeout path
                 raise TimeoutError(f"wait_request(ts={ts}) timed out")
-            self._tracker.pop(ts, None)
+            err = self._errors.pop(ts, None)
+            entry = self._tracker.pop(ts, None)
+            if err is not None and not (entry and entry[1] >= entry[0]):
+                raise RuntimeError(err)
 
     def num_response(self, ts: int) -> int:
         with self._lock:
@@ -81,6 +90,33 @@ class Customer:
                     self._tracker.pop(ts)
                     self._auto_clear.discard(ts)
                 self._cv.notify_all()
+
+    # invoked with (ts, reason) when fail_request hits a callback-driven
+    # (auto_clear) entry, so the app layer can run its failure path — a
+    # cb request has no wait() to surface the error through
+    on_fail = None
+
+    def fail_request(self, ts: int, reason: str) -> None:
+        """Mark an in-flight request undeliverable (transport give-up).
+
+        Waited requests: the error is recorded and wait_request raises.
+        Callback-driven (auto_clear) requests: the tracker entry is
+        dropped and ``on_fail`` fires so the owner can retry or abort —
+        leaving the callback silently un-invoked would wedge protocol
+        state machines built on it (e.g. a HiPS staging cycle)."""
+        hook = None
+        with self._cv:
+            if ts not in self._tracker:
+                return
+            if ts in self._auto_clear:
+                self._tracker.pop(ts, None)
+                self._auto_clear.discard(ts)
+                hook = self.on_fail
+            else:
+                self._errors[ts] = reason
+                self._cv.notify_all()
+        if hook is not None:
+            hook(ts, reason)
 
     # -- inbound ---------------------------------------------------------
 

@@ -152,6 +152,12 @@ class KVWorker:
         self._responses: Dict[int, List[KVPairs]] = {}
         self._response_bodies: Dict[int, List[str]] = {}
         self._callbacks: Dict[int, Callable[[], None]] = {}
+        # ts -> reason for requests the transport gave up on; the callback
+        # still fires (with no response data) and the owner checks
+        # take_failure(ts) to run its failure path — never invoking the
+        # callback would wedge state machines built on it
+        self._failures: Dict[int, str] = {}
+        self.customer.on_fail = self._on_fail
 
     # -- public API ------------------------------------------------------
 
@@ -272,6 +278,21 @@ class KVWorker:
     def take_response_bodies(self, ts: int) -> List[str]:
         with self._lock:
             return self._response_bodies.pop(ts, [])
+
+    def take_failure(self, ts: int) -> Optional[str]:
+        """Give-up reason for ``ts`` if the transport abandoned it, else
+        None. Callbacks should check this before trusting the (absent)
+        response data."""
+        with self._lock:
+            return self._failures.pop(ts, None)
+
+    def _on_fail(self, ts: int, reason: str) -> None:
+        with self._lock:
+            self._failures[ts] = reason
+            self._responses.pop(ts, None)
+            cb = self._callbacks.pop(ts, None)
+        if cb is not None:
+            cb(ts)
 
     # -- inbound ---------------------------------------------------------
 

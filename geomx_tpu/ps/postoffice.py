@@ -54,7 +54,10 @@ class Postoffice:
                               if cfg.resend else 0.0),
             heartbeat_interval_s=cfg.heartbeat_interval_s,
             heartbeat_timeout_s=cfg.heartbeat_timeout_s,
-            use_priority_send=cfg.enable_p3 and my_role == Role.WORKER,
+            # the priority Sending thread runs in EVERY van (reference:
+            # van.cc:548,851) — the party-server→global WAN hop is where
+            # ordering matters most (round-2 Weak #6)
+            use_priority_send=cfg.enable_p3,
             verbose=cfg.verbose,
             # DGT runs on the inter-DC (global) tier only (reference:
             # StartGlobal binds the UDP channels, van.cc:613-646)
@@ -70,6 +73,7 @@ class Postoffice:
             } if (is_global and cfg.enable_dgt) else None,
         )
         self.van.msg_handler = self._dispatch
+        self.van.give_up_handler = self._on_request_undeliverable
         self._customers: Dict[Tuple[int, int], Customer] = {}
         self._customers_lock = threading.Lock()
         self._started = False
@@ -163,18 +167,34 @@ class Postoffice:
         key = (msg.meta.app_id, msg.meta.customer_id)
         with self._customers_lock:
             cust = self._customers.get(key)
-        if cust is None:
-            # fall back to any customer of the app (responses to requests
-            # issued from a different customer_id thread)
+        if cust is None and msg.meta.request:
+            # REQUESTS may fall back to any customer of the app (e.g. TS
+            # relay traffic reaching a node that registered only cid 0).
+            # RESPONSES must NOT: the customer_id identifies the issuing
+            # tracker, and handing a late response to a different
+            # KVWorker (TS = cid 1, command rebroadcast = cid 2) could
+            # satisfy the wrong tracker's wait (round-2 Weak #7).
             with self._customers_lock:
                 for (app, _cid), c in self._customers.items():
                     if app == msg.meta.app_id:
                         cust = c
                         break
         if cust is None:
-            log.warning("no customer for app=%s cid=%s; dropping message", *key)
+            log.warning("no customer for app=%s cid=%s (request=%s); "
+                        "dropping message", key[0], key[1], msg.meta.request)
             return
         cust.accept(msg)
+
+    def _on_request_undeliverable(self, msg: Message) -> None:
+        """Resender gave up on one of OUR requests: fail the tracker entry
+        so wait() raises promptly instead of blocking to its timeout."""
+        with self._customers_lock:
+            cust = self._customers.get((msg.meta.app_id, msg.meta.customer_id))
+        if cust is not None:
+            cust.fail_request(
+                msg.meta.timestamp,
+                f"request ts={msg.meta.timestamp} to node {msg.meta.recver} "
+                f"undeliverable: retransmit retries exhausted")
 
     def attach_ts(self, node) -> None:
         """Register a member-side TSNode to receive REPLY control traffic."""

@@ -10,14 +10,16 @@ not arrived within ``PS_RESEND_TIMEOUT`` milliseconds.
 Deltas from the reference, on purpose:
 - signatures are a per-van nonce (node id + clock-seeded counter) instead
   of a content hash — collision-free and cheaper than hashing payloads;
-- the receiver ACKs after the message was *delivered* without raising —
-  for control messages that means handled, for data/TS messages it means
-  enqueued to the app/TS dispatch queue (the same guarantee ps-lite gives:
-  ACK confirms transport delivery, not application success; handler
-  exceptions are logged by the dispatch loops);
+- the receiver marks-seen and ACKs ON RECEIPT, before processing
+  (matching the reference, resender.h:54): processing is at-most-once —
+  ACK confirms transport delivery, not application success (handler
+  exceptions are logged by the dispatch loops). Marking after processing
+  would let a retransmit that arrives mid-handling be processed twice;
 - retries are capped (``max_retries``, default 10) so a permanently dead
   peer cannot accumulate an unbounded resend queue — the reference leans
-  on heartbeat-based dead-node eviction for that instead.
+  on heartbeat-based dead-node eviction for that instead. On give-up the
+  ``on_give_up`` hook fires and the van routes request failures back to
+  the issuing customer (wait() raises; callbacks get a failure flag).
 
 Enabled via ``PS_RESEND=1`` (reference: van.cc:527-533). Pairs with the
 ``PS_DROP_MSG`` fault injection: a lossy van with resend enabled must
@@ -70,6 +72,12 @@ class Resender:
         self._thread.start()
         self.num_resends = 0
         self.num_duplicates = 0
+        # invoked (outside the lock) with (target, msg) when a message
+        # exhausts max_retries — the van routes request give-ups back to
+        # the issuing customer so its wait() fails fast (the reference
+        # has no cap and leans on heartbeat eviction; with a cap, silence
+        # would leave the requester blocked to its timeout)
+        self.on_give_up = None
 
     # -- sender side -----------------------------------------------------
 
@@ -99,9 +107,10 @@ class Resender:
             return False
 
     def mark_seen(self, sig: int) -> None:
-        """Record an accepted signature — call only after the message was
-        dispatched without raising, so a retransmit re-drives a failed
-        handler instead of being swallowed as a duplicate."""
+        """Record an accepted signature ON RECEIPT, before the message is
+        processed (reference: resender.h:54) — marking later leaves a
+        window where a retransmit of a message still being handled is
+        processed a second time."""
         with self._lock:
             if sig in self._seen:
                 return
@@ -132,6 +141,7 @@ class Resender:
         while not self._stopped.wait(period):
             now = time.monotonic()
             to_resend = []
+            gave_up = []
             with self._lock:
                 for sig, (target, msg, t_sent, n) in list(self._outgoing.items()):
                     if now - t_sent < self.timeout_s * (n + 1):
@@ -140,9 +150,16 @@ class Resender:
                         log.error("giving up on msg sig=%x to %d after %d "
                                   "resends", sig, target, n)
                         self._outgoing.pop(sig, None)
+                        gave_up.append((target, msg))
                         continue
                     self._outgoing[sig] = (target, msg, t_sent, n + 1)
                     to_resend.append((target, msg))
+            for target, msg in gave_up:
+                if self.on_give_up is not None:
+                    try:
+                        self.on_give_up(target, msg)
+                    except Exception:  # noqa: BLE001 — monitor must survive
+                        log.exception("on_give_up hook failed")
             for target, msg in to_resend:
                 self.num_resends += 1
                 try:

@@ -107,6 +107,10 @@ class Van:
 
         # upward dispatch: set by Postoffice before start()
         self.msg_handler: Optional[Callable[[Message], None]] = None
+        # notified with the original request Message when the resender
+        # gives up on delivering it; Postoffice fails the issuing
+        # customer's tracker entry so wait() raises instead of hanging
+        self.give_up_handler: Optional[Callable[[Message], None]] = None
         # TSEngine control traffic (ASKPUSH/ASKPULL/REPLY): set by the
         # Postoffice when TSEngine is enabled for this tier
         self.ts_handler: Optional[Callable[[Message], None]] = None
@@ -149,6 +153,7 @@ class Van:
         self._bind()
         if self.resend_timeout_s > 0:
             self._resender = resender_mod.Resender(self, self.resend_timeout_s)
+            self._resender.on_give_up = self._on_resend_give_up
         if self._native is not None:
             self._spawn(self._native_recv_loop, "van-nrecv")
         else:
@@ -254,6 +259,15 @@ class Van:
                 self._process(msg)
             except Exception:
                 log.exception("error processing inbound frame; loop kept")
+
+    def _on_resend_give_up(self, target: int, msg: Message) -> None:
+        """A message exhausted its retransmit budget. For requests WE
+        issued, surface the failure to the issuing customer so its wait()
+        raises instead of blocking to its own timeout (round-2 advisor
+        finding: resender.py gave up with only log.error)."""
+        if msg.meta.request and msg.meta.timestamp >= 0 \
+                and self.give_up_handler is not None:
+            self.give_up_handler(msg)
 
     def _start_dgt(self) -> None:
         """Bind UDP channels + spawn schedulers (reference: van.cc:613-646)."""
@@ -559,15 +573,17 @@ class Van:
                     # our previous ACK may have been lost: re-ACK, drop
                     r.send_ack(msg)
                     return
+                # mark seen ON RECEIPT, before processing (reference:
+                # resender.h:54) — marking after _process_inner leaves a
+                # window where a retransmit arriving while the original is
+                # still being handled (inline control handling can block on
+                # dials) passes is_duplicate and is processed twice; a
+                # BARRIER counted twice releases early. The ACK goes out
+                # immediately too: processing is at-most-once, the same
+                # guarantee the reference's resender provides.
+                r.mark_seen(msg.meta.msg_sig)
+                r.send_ack(msg)
         self._process_inner(msg)
-        # mark-seen + ACK after successful *delivery*: for control
-        # messages that means handled inline; for data and TS messages it
-        # means enqueued to their dispatch queue (customer/TS loops log
-        # handler exceptions) — the ACK confirms transport delivery, the
-        # same guarantee the reference's resender provides.
-        if r is not None and msg.meta.msg_sig:
-            r.mark_seen(msg.meta.msg_sig)
-            r.send_ack(msg)
 
     def _process_inner(self, msg: Message) -> None:
         cmd = msg.meta.control_cmd
