@@ -231,6 +231,13 @@ class KVStoreDistServer:
         # startup barrier, local tier (reference: kvstore_dist.h:246)
         self.po_local.barrier(psbase.ALL_GROUP, timeout=600.0)
         if self.po_global is not None:
+            if self.is_global_server:
+                # align this process's GLOBAL server rank with its
+                # central-party LOCAL rank: the master worker's init
+                # shards are routed by local rank, and the canonical
+                # range owner is identified by global rank — MultiGPS
+                # breaks unless they name the same process
+                self.po_global.van.sort_key = self.po_local.my_rank
             self.po_global.start(timeout)
             if self.is_global_server:
                 self.server_global = KVServer(self.po_global)
@@ -1094,13 +1101,18 @@ class KVStoreDistServer:
         if head not in (Command.CONTROLLER, Command.SET_GRADIENT_COMPRESSION,
                         Command.SYNC_GLOBAL_MODE, Command.SET_PROFILER_PARAMS):
             return
+        if self.po_global.my_rank != 0:
+            # every global server received the master's command directly
+            # (the master's local SERVER_GROUP is all of them); one
+            # rebroadcaster suffices — and global-to-global rebroadcast
+            # would land on the peer's handler-less _cmd_kvw and deadlock
+            # the waits (MultiGPS hang found in round 3)
+            return
         if self._cmd_kvw is None:
             self._cmd_kvw = KVWorker(self.po_global, customer_id=2)
-        # both tiers: other global servers + party servers (global workers)
-        targets = [psbase.server_rank_to_id(r)
-                   for r in range(self.po_global.num_servers)]
-        targets += [psbase.worker_rank_to_id(r)
-                    for r in range(self.po_global.num_workers)]
+        # party servers (the global tier's workers)
+        targets = [psbase.worker_rank_to_id(r)
+                   for r in range(self.po_global.num_workers)]
         tss = []
         for nid in targets:
             if nid == self.po_global.my_id:

@@ -75,6 +75,13 @@ class Node:
     # DGT lossy channels: UDP ports this node listens on (reference:
     # van.cc:622-646 Bind_UDP + node table broadcast)
     udp_ports: List[int] = dataclasses.field(default_factory=list)
+    # rank-alignment hint: nodes registering on a SECOND tier pass their
+    # first-tier rank so the second tier's scheduler assigns matching
+    # ranks. Central-party servers are global servers; the master's
+    # local-tier init shards must land on the process whose GLOBAL rank
+    # owns the same canonical range, which (host, port)-sorting cannot
+    # guarantee — each tier sorts by a different listener. -1 = unset.
+    sort_key: int = -1
 
     def to_dict(self) -> Dict[str, Any]:
         d = {
@@ -87,6 +94,8 @@ class Node:
         }
         if self.udp_ports:
             d["udp_ports"] = list(self.udp_ports)
+        if self.sort_key >= 0:
+            d["sort_key"] = self.sort_key
         return d
 
     @staticmethod
@@ -99,6 +108,7 @@ class Node:
             is_recovery=bool(d.get("is_recovery", False)),
             customer_id=int(d.get("customer_id", 0)),
             udp_ports=[int(p) for p in d.get("udp_ports", [])],
+            sort_key=int(d.get("sort_key", -1)),
         )
 
 

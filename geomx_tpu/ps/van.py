@@ -327,6 +327,7 @@ class Van:
             hostname=self.bind_host,
             port=self.my_port,
             udp_ports=list(self.udp_ports),
+            sort_key=getattr(self, "sort_key", -1),
         )
         msg = Message(
             Meta(
@@ -672,9 +673,13 @@ class Van:
                 self._registrations.append(node)
             if len(self._registrations) < expected:
                 return
-            # assign ranks deterministically: sort per role by (host, port) so
-            # the same physical topology gets the same ids across runs
-            key = lambda n: (n.hostname, n.port)  # noqa: E731
+            # assign ranks deterministically: sort per role by the
+            # explicit sort_key when provided (rank alignment across
+            # tiers — see Node.sort_key), else by (host, port) so the
+            # same physical topology gets the same ids across runs
+            key = lambda n: ((0, n.sort_key, n.hostname, n.port)
+                             if n.sort_key >= 0
+                             else (1, n.hostname, n.port))  # noqa: E731
             servers = sorted(
                 (n for n in self._registrations if n.role == Role.SERVER), key=key
             )

@@ -10,9 +10,12 @@ export PYTHONPATH="$REPO_DIR${PYTHONPATH:+:$PYTHONPATH}"
 GPORT=${GPORT:-9092}; CPORT=${CPORT:-9093}; APORT=${APORT:-9094}; BPORT=${BPORT:-9095}
 PYTHON=${PYTHON:-python}
 INFRA="-c \"import geomx_tpu\""
+# NGS>1 = MultiGPS: several global servers share the central party
+# (reference: scripts/cpu/run_multi_gps.sh, DMLC_NUM_GLOBAL_SERVER=2)
+NGS=${NGS:-1}
 
 GLOBALS="DMLC_PS_GLOBAL_ROOT_URI=127.0.0.1 DMLC_PS_GLOBAL_ROOT_PORT=$GPORT \
-DMLC_NUM_GLOBAL_SERVER=1 DMLC_NUM_GLOBAL_WORKER=2"
+DMLC_NUM_GLOBAL_SERVER=$NGS DMLC_NUM_GLOBAL_WORKER=2"
 
 launch_hips() {
   local script="$1"; shift
@@ -22,16 +25,18 @@ launch_hips() {
   env $(echo $GLOBALS) DMLC_ROLE_GLOBAL=global_scheduler \
     $PYTHON -c "import geomx_tpu" > /tmp/hips_gsched.log 2>&1 &
   env DMLC_ROLE=scheduler DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$CPORT \
-    DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=1 \
+    DMLC_NUM_SERVER=$NGS DMLC_NUM_WORKER=1 \
     $PYTHON -c "import geomx_tpu" > /tmp/hips_csched.log 2>&1 &
-  env $(echo $GLOBALS) DMLC_ROLE_GLOBAL=global_server DMLC_ROLE=server \
-    DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$CPORT \
-    DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=1 DMLC_ENABLE_CENTRAL_WORKER=0 \
-    DMLC_NUM_ALL_WORKER=4 \
-    $PYTHON -c "import geomx_tpu" > /tmp/hips_gserver.log 2>&1 &
+  for g in $(seq 1 $NGS); do
+    env $(echo $GLOBALS) DMLC_ROLE_GLOBAL=global_server DMLC_ROLE=server \
+      DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$CPORT \
+      DMLC_NUM_SERVER=$NGS DMLC_NUM_WORKER=1 DMLC_ENABLE_CENTRAL_WORKER=0 \
+      DMLC_NUM_ALL_WORKER=4 \
+      $PYTHON -c "import geomx_tpu" > /tmp/hips_gserver$g.log 2>&1 &
+  done
   env DMLC_ROLE=worker DMLC_ROLE_MASTER_WORKER=1 \
     DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$CPORT \
-    DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=1 DMLC_NUM_ALL_WORKER=4 \
+    DMLC_NUM_SERVER=$NGS DMLC_NUM_WORKER=1 DMLC_NUM_ALL_WORKER=4 \
     $PYTHON $script $extra > /tmp/hips_master.log 2>&1 &
 
   # data parties ------------------------------------------------------

@@ -1,0 +1,10 @@
+#!/bin/bash
+# MultiGPS demo: two global servers load-balance the global tier
+# (reference: scripts/cpu/run_multi_gps.sh — DMLC_NUM_GLOBAL_SERVER=2).
+# 13 processes: the central party runs 2 global servers; keys shard
+# across them by the canonical heuristic (small keys hash, big keys
+# split — kvstore_dist.h:725-762 equivalent).
+cd "$(dirname "$0")"
+NGS=2
+source ./hips_env.sh
+launch_hips "$REPO_DIR/examples/cnn.py" --cpu "$@"

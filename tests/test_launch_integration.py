@@ -1,0 +1,83 @@
+"""Launch-path integration test: the REAL multi-process topology.
+
+Spawns the full 12-process, 3-party HiPS demo through the same chain a
+user runs — ``scripts/run_vanilla_hips.sh`` → ``hips_env.sh`` env-var
+wiring → ``import geomx_tpu`` bootstrap for infra roles →
+``examples/cnn.py`` workers — and asserts the observable correctness
+signal the reference uses (climbing test accuracy on the foreground
+worker, reference: scripts/cpu/run_vanilla_hips.sh:8-148 + cnn.py:129).
+
+This covers exactly the path in-process tests cannot: env-var config
+parsing, the import-time server bootstrap (kvstore_server.py), process
+isolation, and clean exit cascades. The round-1 startup-deadlock
+regression shipped through this path while every in-process test stayed
+green.
+"""
+
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_vanilla_hips_subprocess_topology():
+    env = dict(os.environ)
+    env.update({
+        "GPORT": str(_free_port()), "CPORT": str(_free_port()),
+        "APORT": str(_free_port()), "BPORT": str(_free_port()),
+        "JAX_PLATFORMS": "cpu",
+        "PYTHON": sys.executable,
+        # don't inherit the conftest's 8-device virtual mesh into 12
+        # separate processes
+        "XLA_FLAGS": "",
+    })
+    proc = subprocess.Popen(
+        ["bash", os.path.join(REPO, "scripts", "run_vanilla_hips.sh"),
+         "--max-iters", "15"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, start_new_session=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=240)
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, signal.SIGKILL)
+        out, _ = proc.communicate()
+        pytest.fail(f"launch timed out; output:\n{out[-4000:]}")
+
+    assert proc.returncode == 0, f"launch failed:\n{out[-4000:]}"
+    accs = [float(m) for m in re.findall(r"Test Acc (\d+\.\d+)", out)]
+    assert len(accs) == 15, f"expected 15 iteration lines, got:\n{out[-4000:]}"
+    # the correctness signal: training must actually learn (random = 0.1)
+    assert max(accs[-5:]) > 0.4, f"accuracy did not climb: {accs}"
+    assert max(accs[-5:]) > accs[0], f"accuracy did not improve: {accs}"
+
+    # clean exits: every background process of the group must terminate
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            os.killpg(proc.pid, 0)
+        except ProcessLookupError:
+            break  # whole group gone
+        time.sleep(0.5)
+    else:
+        os.killpg(proc.pid, signal.SIGKILL)
+        pytest.fail("background topology processes did not exit cleanly")
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-x", "-q"]))
