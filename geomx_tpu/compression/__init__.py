@@ -3,8 +3,11 @@
 Re-implements the reference's GradientCompression family (reference:
 src/kvstore/gradient_compression.cc:40-336, kernels
 gradient_compression-inl.h:40-155) as host-side numpy kernels used on the
-inter-DC hop by the HiPS server (jax/Pallas device versions live in
-``geomx_tpu.ops`` for in-step use). Placement matches the reference: the
+inter-DC hop by the HiPS server. Device (JAX/XLA + Pallas) versions live
+in ``geomx_tpu.ops``; ``make_compressor({"type": "bsc", "device": true})``
+or GEOMX_DEVICE_COMPRESSION=1 routes the server's WAN hop through them —
+for >=1M-element keys the device top-k dominates the host partition
+(tools/compress_bench.py). Placement matches the reference: the
 LAN tier is uncompressed; party servers compress the aggregated gradient
 before the WAN push (BSCompress, :191), the global server decompresses,
 aggregates, and compresses pull responses with the non-zero filter scaled
@@ -32,6 +35,23 @@ __all__ = ["make_compressor", "Compressor", "FP16Compressor", "BSCCompressor",
            "bsc_pull_compress", "two_bit_quantize", "two_bit_dequantize"]
 
 BSC_MOMENTUM = 0.9  # reference: gradient_compression.cc:198
+
+
+def _ops():
+    """geomx_tpu.ops via sys.modules-or-import. make_compressor runs in
+    SERVER HANDLER THREADS (SET_GRADIENT_COMPRESSION command) while the
+    server's main thread may be blocked inside ``import geomx_tpu``; a
+    plain function-local import would deadlock on the package import
+    lock, so resolve from sys.modules first (geomx_tpu/__init__ imports
+    ops eagerly)."""
+    import sys
+
+    mod = sys.modules.get("geomx_tpu.ops")
+    if mod is not None:
+        return mod
+    from geomx_tpu import ops
+
+    return ops
 
 
 # ---------------------------------------------------------------------------
@@ -256,9 +276,15 @@ class MPQCompressor(Compressor):
 
     type_name = "mpq"
 
-    def __init__(self, threshold: float = 0.01, size_lower_bound: int = 200000):
+    def __init__(self, threshold: float = 0.01, size_lower_bound: int = 200000,
+                 device: bool = False):
         self.size_lower_bound = size_lower_bound
-        self._bsc = BSCCompressor(threshold)
+        if device:
+            # the large-tensor path is exactly what the device kernels
+            # exist for (>= size_lower_bound elements go BSC)
+            self._bsc = _ops().DeviceBSCCompressor(threshold)
+        else:
+            self._bsc = BSCCompressor(threshold)
         self._fp16 = FP16Compressor()
 
     def _route(self, num_elems: int) -> Compressor:
@@ -290,11 +316,21 @@ def make_compressor(params: Optional[dict]) -> Compressor:
     if ctype == "fp16":
         return FP16Compressor()
     if ctype == "bsc":
-        return BSCCompressor(float(params.get("threshold", 0.01)))
+        threshold = float(params.get("threshold", 0.01))
+        use_device = params.get("device")
+        if use_device is None:
+            use_device = _ops().device_compression_enabled()
+        if use_device:
+            return _ops().DeviceBSCCompressor(threshold)
+        return BSCCompressor(threshold)
     if ctype == "2bit":
         return TwoBitCompressor(float(params.get("threshold", 0.5)))
     if ctype == "mpq":
+        use_device = params.get("device")
+        if use_device is None:
+            use_device = _ops().device_compression_enabled()
         return MPQCompressor(
             float(params.get("threshold", 0.01)),
-            int(params.get("size_lower_bound", 200000)))
+            int(params.get("size_lower_bound", 200000)),
+            device=bool(use_device))
     raise ValueError(f"Unknown gradient compression type {ctype!r}")

@@ -102,13 +102,17 @@ class KVStoreDist(KVStore):
         self._transport_errors: List[str] = []
 
         # startup barrier (reference: kvstore_dist.h:64), then the
-        # creation-time command protocol (reference: kvstore.cc:56-63)
-        self.po.barrier(psbase.ALL_GROUP, timeout=600.0)
-        if self.rank == 0:
-            self._send_command(Command.SYNC_MODE, "1")
-        if self.is_master_worker:
-            self._send_command(Command.SYNC_GLOBAL_MODE,
-                               "1" if sync_global else "0")
+        # creation-time command protocol (reference: kvstore.cc:56-63).
+        # A recovering worker skips both: the survivors will not re-join
+        # the barrier (reference: is_recovery gate, kvstore_dist.h:63)
+        # and the cluster already runs the right modes.
+        if not self.po.van.is_recovery:
+            self.po.barrier(psbase.ALL_GROUP, timeout=600.0)
+            if self.rank == 0:
+                self._send_command(Command.SYNC_MODE, "1")
+            if self.is_master_worker:
+                self._send_command(Command.SYNC_GLOBAL_MODE,
+                                   "1" if sync_global else "0")
         self._closed = False
         import atexit
 
@@ -194,7 +198,10 @@ class KVStoreDist(KVStore):
                               lens=[sh.length])
                 ts = self.kvw.push(kvs, sh.server_rank, cmd=DATA_INIT)
                 self.kvw.wait(ts, 120.0)
-        self.barrier()
+        if not self.po.van.is_recovery:
+            # survivors won't re-join init barriers; the store is already
+            # initialized (a duplicate DATA_INIT is acked and ignored)
+            self.barrier()
 
     def push(self, key, value, priority: int = 0) -> None:
         keys = self._as_key_list(key)

@@ -8,8 +8,10 @@ protocol, re-designed for host-side asynchrony without the MXNet engine:
   this process is a server, and the inter-DC ("global") tier where it is
   either a global worker (ordinary party server) or a global server
   (central party; reference kvstore_dist.h:237-258 RunServer);
-- per-(key, shard-offset) states guarded by one lock; all protocol
-  transitions are callback-driven (no spin-waits, unlike the reference's
+- per-(key, shard-offset) states each guarded by their OWN lock, so
+  independent keys aggregate in parallel (the reference serializes per
+  key via update_buf_ + engine var-deps; round-2 Weak #4 flagged our
+  earlier single global lock); all protocol transitions are callback-driven (no spin-waits, unlike the reference's
   DataHandlePullDefault sleep-loop at kvstore_dist_server.h:1736-1739);
 - the synchronization backbone mirrors the reference exactly: worker push
   acks are DEFERRED until the round's fresh parameters are in the store
@@ -56,7 +58,9 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from geomx_tpu import checkpoint  # module-level: used in handler threads
 from geomx_tpu import config as cfg_mod
+from geomx_tpu import kernels_native
 from geomx_tpu import profiler
 from geomx_tpu.compression import make_compressor
 from geomx_tpu.kvstore import sharding
@@ -97,14 +101,18 @@ class _KeyState:
     """Per-(key, shard-offset) protocol state (UpdateBuf + store_ entry)."""
 
     __slots__ = (
+        "lock",
         "stored", "outbound", "milestone", "merged", "push_reqs",
         "deferred_acks", "pending_pulls", "initialized", "staging", "rounds",
         "offset", "length", "total", "dtype", "elems_received", "init_elems",
         "fwd_parts", "fwd_expected", "fwd_acks_left", "version", "cycle",
-        "pre_init_pushes", "central_pushes",
+        "fwd_wire", "pre_init_pushes", "central_pushes",
     )
 
     def __init__(self, offset: int):
+        # every access to this state goes through this lock (RLock: the
+        # pre-init replay path re-enters _global_slice_push)
+        self.lock = threading.RLock()
         self.stored: Optional[np.ndarray] = None
         # the aggregate staged for the global tier lives here, NEVER in
         # `stored` — `stored` always holds parameters, so a pull can never
@@ -130,6 +138,12 @@ class _KeyState:
         self.fwd_parts: Dict[int, np.ndarray] = {}
         self.fwd_expected = 0
         self.fwd_acks_left = 0
+        # lo -> (wire_val, aux, compr) for the CURRENT cycle's forward.
+        # Compression (BSC momentum/residual) destructively updates its
+        # state, so a WAN retry must resend the SAME wire payload — a
+        # recompress would double-count the gradient and lose the first
+        # selection's mass
+        self.fwd_wire: Dict[int, tuple] = {}
         self.version = 0
         # id of the CURRENT forward/pull-back cycle. Every global-tier
         # callback (push ack, pull data, TS model) carries the cycle it was
@@ -170,7 +184,13 @@ class KVStoreDistServer:
                 cfg=c,
             )
 
+        # short-lived structural lock (states dict, counters, barriers);
+        # data-plane work runs under per-state locks
         self._lock = threading.RLock()
+        # build/load the native kernels BEFORE serving traffic: the lazy
+        # first-use build (g++, seconds) would otherwise run inside a
+        # push handler while holding a key's state lock
+        kernels_native.lib()
         self._states: Dict[Tuple[int, int], _KeyState] = {}
         self._key_total: Dict[int, int] = {}
         self.sync_mode = True
@@ -228,8 +248,11 @@ class KVStoreDistServer:
             self._ts_kvw_local = KVWorker(self.po_local, customer_id=1)
             self.ts_local = TSNode(self.po_local, self._ts_kvw_local,
                                    tgt_merge=self.po_local.num_workers)
-        # startup barrier, local tier (reference: kvstore_dist.h:246)
-        self.po_local.barrier(psbase.ALL_GROUP, timeout=600.0)
+        # startup barrier, local tier (reference: kvstore_dist.h:246);
+        # a recovering server skips it — survivors won't re-join
+        # (reference: kvstore_dist.h:63 via is_recovery)
+        if not self.po_local.van.is_recovery:
+            self.po_local.barrier(psbase.ALL_GROUP, timeout=600.0)
         if self.po_global is not None:
             if self.is_global_server:
                 # align this process's GLOBAL server rank with its
@@ -316,29 +339,34 @@ class KVStoreDistServer:
     def _handle_data(self, req: ReqMeta, kvs: KVPairs, srv: KVServer,
                      global_store: bool, global_tier: bool) -> None:
         acts: List[Action] = []
-        with self._lock:
-            for i, key in enumerate(kvs.keys):
-                off = kvs.offset_of(i)
-                total = kvs.total_of(i)
-                if req.push:
-                    val = np.asarray(kvs.vals[i]).ravel()
-                    if kvs.compr:
-                        val = self.gc.decompress_push(
-                            kvs.compr, val, kvs.aux[i], kvs.len_of(i) or val.size)
-                    total = total or val.size
-                    self._key_total[key] = max(self._key_total.get(key, 0), total)
-                    if global_store:
-                        acts += self._push_global_store(
-                            req, srv, key, off, val, total, global_tier)
-                    else:
-                        acts += self._push_local_store(req, srv, key, off, val,
-                                                       total)
-                elif req.pull:
-                    length = kvs.len_of(i)
-                    if global_store:
-                        acts += self._pull_global_store(
-                            req, srv, key, off, length, total, kvs.compr)
-                    else:
+        for i, key in enumerate(kvs.keys):
+            off = kvs.offset_of(i)
+            total = kvs.total_of(i)
+            if req.push:
+                val = np.asarray(kvs.vals[i]).ravel()
+                if kvs.compr:
+                    val = self.gc.decompress_push(
+                        kvs.compr, val, kvs.aux[i], kvs.len_of(i) or val.size)
+                total = total or val.size
+                with self._lock:
+                    self._key_total[key] = max(self._key_total.get(key, 0),
+                                               total)
+                if global_store:
+                    acts += self._push_global_store(
+                        req, srv, key, off, val, total, global_tier)
+                else:
+                    st = self._state(key, off)
+                    with st.lock:
+                        acts += self._push_local_store(req, srv, key, off,
+                                                       val, total)
+            elif req.pull:
+                length = kvs.len_of(i)
+                if global_store:
+                    acts += self._pull_global_store(
+                        req, srv, key, off, length, total, kvs.compr)
+                else:
+                    st = self._state(key, off)
+                    with st.lock:
                         acts += self._pull_local_store(req, srv, key, off)
         for fn in acts:
             fn()
@@ -371,11 +399,22 @@ class KVStoreDistServer:
             st.initialized = True
             return [lambda: srv.response(req)] + self._flush_pulls(st, key)
 
-        # aggregate (reference: :1288-1298)
+        if req.head == DATA_INIT:
+            # duplicate init (e.g. a recovered rank-0 worker re-running
+            # kv.init against a surviving server): ack and ignore — it
+            # must NOT be aggregated as a gradient (reference initialized_
+            # gate, kvstore_dist_server.h:1241-1262)
+            return [lambda: srv.response(req)]
+
+        # aggregate (reference: :1288-1298); the += runs natively (GIL
+        # released) when the kernels library is available, so concurrent
+        # keys aggregate in parallel under their per-state locks
         if not st.push_reqs:
             st.merged = val.astype(np.float32, copy=True)
         else:
-            st.merged += val
+            v32 = np.ascontiguousarray(val, dtype=np.float32)
+            if not kernels_native.acc(st.merged, v32):
+                st.merged += v32
         st.push_reqs.extend([(req, srv)] * max(req.num_merge, 1))
         if len(st.push_reqs) < self.po_local.num_workers:
             return []
@@ -441,8 +480,10 @@ class KVStoreDistServer:
                 continue
             touched = True
             sub = val[lo - off:hi - off]
-            acts += self._global_slice_push(req, srv, key, rng, lo, sub,
-                                            total, from_global_tier)
+            st = self._state(key, rng.offset)
+            with st.lock:
+                acts += self._global_slice_push(req, srv, key, rng, lo, sub,
+                                                total, from_global_tier)
         if not touched:
             log.warning("push key=%d off=%d total=%d missed all canonical "
                         "ranges of global rank %d", key, off, total,
@@ -531,14 +572,18 @@ class KVStoreDistServer:
         if st.merged is None:
             st.merged = np.zeros(st.length, dtype=np.float32)
             st.elems_received = 0
-        st.merged[lo - rng.offset:lo - rng.offset + sub.size] += sub
+        seg = st.merged[lo - rng.offset:lo - rng.offset + sub.size]
+        sub32 = np.ascontiguousarray(sub, dtype=np.float32)
+        if not kernels_native.acc(seg, sub32):
+            seg += sub32
         # TSEngine final hops carry num_merge parties' worth of gradient in
         # one push (reference counting: kvstore_dist_server.h:1301)
         st.elems_received += sub.size * max(req.num_merge, 1)
         st.push_reqs.append((req, srv))
         if from_global_tier:
             pn = max(req.party_nsrv, 1)
-            prev = self._party_nsrv_by_sender.setdefault(req.sender, pn)
+            with self._lock:
+                prev = self._party_nsrv_by_sender.setdefault(req.sender, pn)
             if prev != pn:
                 log.error("global worker %d changed party_nsrv %d -> %d "
                           "mid-run; round counting may be wrong",
@@ -602,7 +647,8 @@ class KVStoreDistServer:
 
     def _pull_global_store(self, req, srv, key, off, length, total,
                            req_compr) -> List[Action]:
-        total = total or self._key_total.get(key, 0)
+        with self._lock:
+            total = total or self._key_total.get(key, 0)
         acts: List[Action] = []
         for rng in self._canonical_ranges(key, total):
             req_lo = off
@@ -610,11 +656,12 @@ class KVStoreDistServer:
             if req_hi <= rng.offset or req_lo >= rng.offset + rng.length:
                 continue
             st = self._state(key, rng.offset)
-            if not st.initialized:
-                st.pending_pulls.append((req, srv, off, length))
-                continue
-            acts.append(self._pull_response_action(st, req, srv, key, off,
-                                                   length, req_compr))
+            with st.lock:
+                if not st.initialized:
+                    st.pending_pulls.append((req, srv, off, length))
+                    continue
+                acts.append(self._pull_response_action(st, req, srv, key, off,
+                                                       length, req_compr))
         return acts
 
     def _pull_response_action(self, st: _KeyState, req, srv, key,
@@ -671,24 +718,29 @@ class KVStoreDistServer:
         if self.ts_global is not None and self.sync_global_mode:
             self._ts_forward_to_global(key, off, cycle)
             return
-        with self._lock:
-            st = self._state(key, off)
+        st = self._state(key, off)
+        with st.lock:
             if st.cycle != cycle:
                 return
             total = st.total
             slices = self._global_slices(key, off, st.length, total)
             st.fwd_acks_left = len(slices)
+            st.fwd_wire = {}
         for g_rank, lo, hi in slices:
             self._push_slice_global(key, off, cycle, g_rank, lo, hi, total)
 
     def _push_slice_global(self, key, off, cycle, g_rank, lo, hi,
                            total) -> None:
-        with self._lock:
-            st = self._state(key, off)
+        st = self._state(key, off)
+        with st.lock:
             if st.cycle != cycle or st.outbound is None:
                 return
-            sub = np.ascontiguousarray(st.outbound[lo - off:hi - off])
-        wire_val, aux, compr = self.gc.compress_push(sub, (key, lo))
+            cached = st.fwd_wire.get(lo)
+            if cached is None:
+                sub = np.ascontiguousarray(st.outbound[lo - off:hi - off])
+                cached = self.gc.compress_push(sub, (key, lo))
+                st.fwd_wire[lo] = cached
+        wire_val, aux, compr = cached
         kvs = KVPairs(keys=[key], vals=[wire_val], aux=[aux],
                       offsets=[lo], totals=[total], lens=[hi - lo],
                       compr=compr)
@@ -701,8 +753,8 @@ class KVStoreDistServer:
         """Inter-TS: contribute each global slice to the overlay (merged
         party-to-party), watch for the disseminated model (reference: the
         TS_Push / AutoPull2 path)."""
-        with self._lock:
-            st = self._state(key, off)
+        st = self._state(key, off)
+        with st.lock:
             if st.cycle != cycle:
                 return
             payload = st.outbound
@@ -733,8 +785,8 @@ class KVStoreDistServer:
     def _on_ts_global_model(self, key, off, rng_off, lo, hi, cycle) -> None:
         data = self.ts_global.model_of(key, rng_off)
         acts: List[Action] = []
-        with self._lock:
-            st = self._state(key, off)
+        st = self._state(key, off)
+        with st.lock:
             if st.cycle != cycle:
                 return
             if data is not None:
@@ -822,8 +874,8 @@ class KVStoreDistServer:
                               g_rank, lo, hi, total)
             return
         issue = False
-        with self._lock:
-            st = self._state(key, off)
+        st = self._state(key, off)
+        with st.lock:
             if st.cycle != cycle:
                 return
             st.fwd_acks_left -= 1
@@ -838,8 +890,8 @@ class KVStoreDistServer:
         t.start()
 
     def _global_pull(self, key: int, off: int, cycle: int) -> None:
-        with self._lock:
-            st = self._state(key, off)
+        st = self._state(key, off)
+        with st.lock:
             if st.cycle != cycle:
                 return
             slices = self._global_slices(key, off, st.length, st.total)
@@ -851,8 +903,9 @@ class KVStoreDistServer:
 
     def _pull_slice_global(self, key, off, cycle, g_rank, lo, hi,
                            total) -> None:
-        with self._lock:
-            if self._state(key, off).cycle != cycle:
+        st = self._state(key, off)
+        with st.lock:
+            if st.cycle != cycle:
                 return
         self.worker_global.pull(
             [key], g_rank, offsets=[lo], totals=[total], lens=[hi - lo],
@@ -872,8 +925,8 @@ class KVStoreDistServer:
         # drain the tracker even when the cycle guard discards the data
         resps = self.worker_global.take_response(ts)
         acts: List[Action] = []
-        with self._lock:
-            st = self._state(key, off)
+        st = self._state(key, off)
+        with st.lock:
             if st.cycle != cycle:
                 return
             for kvs in resps:
@@ -915,6 +968,7 @@ class KVStoreDistServer:
         st.initialized = True
         st.staging = False
         st.outbound = None
+        st.fwd_wire = {}
         st.version += 1
         acks, st.deferred_acks = st.deferred_acks, []
         acts: List[Action] = [lambda r=r, s=s: s.response(r)
@@ -960,15 +1014,12 @@ class KVStoreDistServer:
                 srv.response(req, body=json.dumps(
                     self._relay_optimizer_states_get()))
                 return
-            from geomx_tpu import checkpoint
-
-            states = (self.updater.get_states()
-                      if self.updater is not None else {})
+            states_hex = checkpoint.serialize_states(
+                self._snapshot_states()).hex()
             rank = (self.po_global.my_rank
                     if self.is_global_server and self.po_global is not None
                     else self.po_local.my_rank)
-            srv.response(req, body=json.dumps(
-                {str(rank): checkpoint.serialize_states(states).hex()}))
+            srv.response(req, body=json.dumps({str(rank): states_hex}))
             return
         if head == Command.SET_OPTIMIZER_STATES:
             if (self.has_global_tier and not global_tier
@@ -977,8 +1028,6 @@ class KVStoreDistServer:
                 self._relay_optimizer_states_set(body)
                 srv.response(req)
                 return
-            from geomx_tpu import checkpoint
-
             per_server = json.loads(body)
             if set(per_server) == {"rank", "states"}:
                 # legacy single-server wire shape ({"rank": r, "states": s})
@@ -988,6 +1037,7 @@ class KVStoreDistServer:
                     else self.po_local.my_rank)
             mine = per_server.get(str(rank))
             if mine is not None and self.updater is not None:
+                # whole-dict replacement: a single GIL-atomic assignment
                 self.updater.set_states(
                     checkpoint.deserialize_states(bytes.fromhex(mine)))
             srv.response(req)
@@ -1050,6 +1100,25 @@ class KVStoreDistServer:
             self.po_global.barrier(psbase.WORKER_SERVER_GROUP, timeout=600.0)
         for r, s in reqs:
             s.response(r)
+
+    def _snapshot_states(self) -> Dict:
+        """Consistent deep copy of the updater's per-key states.
+
+        Updates run GIL-FREE (native kernels) under each key's state
+        lock, so a plain read could capture a half-written m/v buffer;
+        copy each entry while holding its key's lock. The dict itself is
+        snapshotted first (per-key inserts are GIL-atomic)."""
+        import copy as _copy
+
+        if self.updater is None:
+            return {}
+        out: Dict = {}
+        for k, v in dict(self.updater.get_states()).items():
+            key, offset = k if isinstance(k, tuple) else (k, 0)
+            st = self._state(key, offset)
+            with st.lock:
+                out[k] = _copy.deepcopy(v)
+        return out
 
     def _relay_optimizer_states_get(self) -> Dict[str, str]:
         """Party server: fetch the live states from every global server
@@ -1143,7 +1212,8 @@ class KVStoreDistServer:
     # ------------------------------------------------------------------
 
     def _state(self, key: int, offset: int) -> _KeyState:
-        return self._states.setdefault((key, offset), _KeyState(offset))
+        with self._lock:
+            return self._states.setdefault((key, offset), _KeyState(offset))
 
     def _canonical_ranges(self, key: int, total: int) -> List[sharding.Shard]:
         """This global server's canonical shard(s) of ``key``."""

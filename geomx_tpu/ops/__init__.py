@@ -1,0 +1,309 @@
+"""Device-side compression kernels (JAX/XLA + Pallas).
+
+The reference runs gradient compression as device kernels
+(reference: src/kvstore/gradient_compression-inl.h:40-155 CPU kernels,
+gradient_compression.cu CUDA kernels) so compression never round-trips
+through host memory. This module is the TPU equivalent for the hot ops
+on the WAN hop:
+
+- ``bsc_compress``      — momentum-corrected top-k sparsification via
+  ``jax.lax.top_k`` (exact, vs the reference's sampled boundary at
+  gradient_compression.cc:203-233 — top-k maps directly onto the TPU
+  sort unit, so sampling would save nothing and cost exactness);
+- ``bsc_decompress``    — scatter back to dense;
+- ``two_bit_quantize`` / ``two_bit_dequantize`` — residual-feedback
+  2-bit codes packed 4/byte (reference -inl.h bitmask kernels), with an
+  optional fused Pallas kernel for the pack;
+- ``dgt_block_contrib`` — per-block mean |g| EWMA scoring for DGT
+  channel assignment (reference: EvalMsgContribution, kv_app.h:978).
+
+All functions are pure (state in, state out) and jit-compiled per
+(shape, static-arg) signature. The host-side numpy kernels in
+``geomx_tpu.compression`` remain the fallback for processes without an
+accelerator; ``DeviceBSCCompressor`` below adapts these kernels to the
+server's Compressor interface and is selected by
+``make_compressor({"device": true, ...})`` or GEOMX_DEVICE_COMPRESSION=1.
+
+JAX is imported lazily: infra processes (schedulers, pure-CPU servers)
+must not pay jax import/initialization cost unless they opt in.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "bsc_compress", "bsc_decompress", "bsc_pull_compress",
+    "two_bit_quantize", "two_bit_dequantize", "dgt_block_contrib",
+    "DeviceBSCCompressor", "device_compression_enabled",
+]
+
+BSC_MOMENTUM = 0.9  # reference: gradient_compression.cc:198
+
+
+def device_compression_enabled() -> bool:
+    return os.environ.get("GEOMX_DEVICE_COMPRESSION", "") not in ("", "0")
+
+
+# ---------------------------------------------------------------------------
+# jitted kernels (built lazily, cached per static signature)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _bsc_compress_fn(k: int):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fn(grad, u, v):
+        u = BSC_MOMENTUM * u + grad
+        v = v + u
+        mags, idx = jax.lax.top_k(jnp.abs(v), k)
+        vals = v[idx]
+        v = v.at[idx].set(0.0)
+        u = u.at[idx].set(0.0)
+        return vals, idx.astype(jnp.int32), u, v
+
+    return fn
+
+
+def bsc_compress(grad, u, v, threshold: float):
+    """Momentum-corrected EXACT top-k selection on device.
+
+    Returns ``(values, indices, new_u, new_v)`` — functional counterpart
+    of the reference's in-place BSCompress (gradient_compression.cc:191).
+    """
+    k = max(int(grad.size * threshold), 1)
+    return _bsc_compress_fn(k)(grad, u, v)
+
+
+@functools.lru_cache(maxsize=None)
+def _bsc_decompress_fn(n: int):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fn(values, indices):
+        return jnp.zeros(n, jnp.float32).at[indices].set(values)
+
+    return fn
+
+
+def bsc_decompress(values, indices, original_size: int):
+    """Scatter-back (reference: BSCDecompress :310-336)."""
+    return _bsc_decompress_fn(original_size)(values, indices)
+
+
+@functools.lru_cache(maxsize=None)
+def _bsc_pull_fn(cap: int):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fn(arr):
+        # the reference's non-zero filter (BSCPullCompress :271-308):
+        # top-|value| selection is equivalent on an aggregate whose
+        # nonzeros number <= cap, and degrades gracefully past cap
+        mags, idx = jax.lax.top_k(jnp.abs(arr), cap)
+        return arr[idx], idx.astype(jnp.int32)
+
+    return fn
+
+
+def bsc_pull_compress(arr, threshold: float, multiplier: int):
+    cap = max(min(int(arr.size * threshold * multiplier), arr.size), 1)
+    return _bsc_pull_fn(cap)(arr)
+
+
+@functools.lru_cache(maxsize=None)
+def _two_bit_fn(n: int, use_pallas: bool):
+    import jax
+    import jax.numpy as jnp
+
+    pad = (-n) % 4
+
+    def pack_jnp(codes):
+        c = codes.reshape(-1, 4).astype(jnp.uint8)
+        return c[:, 0] | (c[:, 1] << 2) | (c[:, 2] << 4) | (c[:, 3] << 6)
+
+    if use_pallas:
+        pack = _pallas_pack4(n + pad)
+    else:
+        pack = pack_jnp
+
+    @jax.jit
+    def fn(grad, residual, threshold):
+        r = residual + grad
+        pos = r > threshold
+        neg = r < -threshold
+        codes = jnp.where(pos, 1, jnp.where(neg, 2, 0)).astype(jnp.uint8)
+        r = jnp.where(pos, r - threshold, jnp.where(neg, r + threshold, r))
+        if pad:
+            codes = jnp.concatenate(
+                [codes, jnp.zeros(pad, jnp.uint8)])
+        return pack(codes), r
+
+    return fn
+
+
+def _pallas_pack4(n4: int):
+    """Fused 4-codes-per-byte pack as a Pallas VMEM kernel (TPU); the
+    jnp path is used in interpret mode elsewhere. n4 % 4 == 0."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    m = n4 // 4
+
+    def kernel(codes_ref, out_ref):
+        c = codes_ref[:].reshape(m, 4)
+        out_ref[:] = (c[:, 0] | (c[:, 1] << 2) | (c[:, 2] << 4)
+                      | (c[:, 3] << 6))
+
+    interpret = jax.default_backend() != "tpu"
+
+    def pack(codes):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((m,), jnp.uint8),
+            interpret=interpret,
+        )(codes)
+
+    return pack
+
+
+def two_bit_quantize(grad, residual, threshold: float,
+                     use_pallas: bool = False):
+    """Residual-feedback 2-bit quantization, 4 codes/byte.
+
+    Returns ``(packed_uint8, new_residual)``."""
+    import jax.numpy as jnp
+
+    fn = _two_bit_fn(int(grad.size), use_pallas)
+    return fn(grad, residual, jnp.float32(threshold))
+
+
+@functools.lru_cache(maxsize=None)
+def _two_bit_deq_fn(n: int):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fn(packed, threshold):
+        c = jnp.stack([packed & 3, (packed >> 2) & 3,
+                       (packed >> 4) & 3, (packed >> 6) & 3],
+                      axis=1).reshape(-1)[:n]
+        return jnp.where(c == 1, threshold,
+                         jnp.where(c == 2, -threshold, 0.0)
+                         ).astype(jnp.float32)
+
+    return fn
+
+
+def two_bit_dequantize(packed, original_size: int, threshold: float):
+    import jax.numpy as jnp
+
+    return _two_bit_deq_fn(int(original_size))(packed,
+                                               jnp.float32(threshold))
+
+
+@functools.lru_cache(maxsize=None)
+def _dgt_contrib_fn(n: int, block_size: int, alpha: float):
+    import jax
+    import jax.numpy as jnp
+
+    nblocks = -(-n // block_size)
+    pad = nblocks * block_size - n
+
+    @jax.jit
+    def fn(grad, prev):
+        g = jnp.abs(grad)
+        if pad:
+            g = jnp.concatenate([g, jnp.zeros(pad, g.dtype)])
+        # padded tail block: mean over true elements
+        sums = g.reshape(nblocks, block_size).sum(axis=1)
+        counts = jnp.full((nblocks,), block_size, jnp.float32)
+        if pad:
+            counts = counts.at[-1].set(block_size - pad)
+        cur = sums / counts
+        return alpha * prev + (1.0 - alpha) * cur
+
+    return fn
+
+
+def dgt_block_contrib(grad, prev, block_size: int, alpha: float):
+    """EWMA per-block mean |g| (reference: EvalMsgContribution,
+    kv_app.h:978) — the DGT channel-assignment score, on device."""
+    return _dgt_contrib_fn(int(grad.size), int(block_size),
+                           float(alpha))(grad, prev)
+
+
+# ---------------------------------------------------------------------------
+# server-side adapter
+# ---------------------------------------------------------------------------
+
+class DeviceBSCCompressor:
+    """Drop-in for compression.BSCCompressor with device state/kernels.
+
+    Per-key momentum (u) and accumulation (v) stay resident on the
+    accelerator; only the compressed (values, indices) pair crosses to
+    host for the wire. For >=1M-element keys the device top-k beats the
+    host partition by an order of magnitude (tools/compress_bench.py).
+    """
+
+    type_name = "bsc"
+
+    def __init__(self, threshold: float = 0.01):
+        self.threshold = threshold
+        self._u = {}
+        self._v = {}
+
+    def compress_push(self, arr, state_key=None):
+        import jax.numpy as jnp
+
+        a = jnp.asarray(np.asarray(arr, dtype=np.float32))
+        if state_key not in self._u:
+            self._u[state_key] = jnp.zeros(a.size, jnp.float32)
+            self._v[state_key] = jnp.zeros(a.size, jnp.float32)
+        vals, idx, self._u[state_key], self._v[state_key] = bsc_compress(
+            a, self._u[state_key], self._v[state_key], self.threshold)
+        return (np.asarray(vals, dtype=np.float32),
+                np.asarray(idx, dtype=np.int32), "bsc")
+
+    def decompress_push(self, tag, val, aux, orig_len):
+        if tag == "bsc" and orig_len >= 1 << 16:
+            return np.asarray(bsc_decompress(
+                np.asarray(val, np.float32), np.asarray(aux, np.int32),
+                orig_len))
+        # resolve via sys.modules: this method runs in server handler
+        # threads, where a function-local geomx_tpu import can deadlock
+        # on the package import lock (compression is always imported —
+        # it is the only constructor of this class)
+        import sys
+
+        return sys.modules["geomx_tpu.compression"]._generic_decompress(
+            tag, val, aux, orig_len)
+
+    def compress_pull(self, tag, arr, factor):
+        if tag != "bsc":
+            import sys
+
+            return sys.modules["geomx_tpu.compression"].Compressor(
+            ).compress_pull(tag, arr, factor)
+        vals, idx = bsc_pull_compress(
+            np.asarray(arr, dtype=np.float32), self.threshold, factor)
+        return (np.asarray(vals, dtype=np.float32),
+                np.asarray(idx, dtype=np.int32))
+
+    def decompress_pull(self, tag, val, aux, orig_len, factor):
+        return self.decompress_push(tag, val, aux, orig_len)
+
+    def pull_compr_tag(self, num_elems: int = 0) -> str:
+        return "bsc"
+
+    def push_tag(self, num_elems: int = 0) -> str:
+        return "bsc"

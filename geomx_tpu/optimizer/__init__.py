@@ -22,6 +22,13 @@ from typing import Dict, Optional
 
 import numpy as np
 
+# module-level on purpose: optimizer steps run in SERVER HANDLER THREADS
+# while the server's main thread may be blocked INSIDE ``import
+# geomx_tpu`` (bootstrap); a function-local ``from geomx_tpu import ...``
+# there deadlocks on the package import lock (see kvstore.server
+# _SysModulesUnpickler for the same hazard)
+from geomx_tpu import kernels_native
+
 __all__ = ["Optimizer", "SGD", "Adam", "DCASGD", "create"]
 
 
@@ -80,6 +87,13 @@ class SGD(Optimizer):
         return np.zeros_like(weight, dtype=np.float32)
 
     def step(self, key, weight, grad, state):
+        # native path (GIL-free; reference runs this math in C++ too)
+        if kernels_native.usable(weight.size):
+            w = np.array(weight, dtype=np.float32, copy=True)
+            g = np.ascontiguousarray(grad, dtype=np.float32)
+            if kernels_native.sgd(w, g, state, self.learning_rate,
+                                  self.momentum, self.wd):
+                return w
         grad = grad + self.wd * weight
         if state is None:
             return weight - self.learning_rate * grad
@@ -106,10 +120,18 @@ class Adam(Optimizer):
         }
 
     def step(self, key, weight, grad, state):
-        grad = grad + self.wd * weight
         state["t"] += 1
         t = state["t"]
         m, v = state["m"], state["v"]
+        # native path (GIL-free; reference runs this math in C++ too)
+        if kernels_native.usable(weight.size):
+            w = np.array(weight, dtype=np.float32, copy=True)
+            g = np.ascontiguousarray(grad, dtype=np.float32)
+            if kernels_native.adam(w, g, m, v, self.learning_rate,
+                                   self.beta1, self.beta2, self.epsilon,
+                                   self.wd, t):
+                return w
+        grad = grad + self.wd * weight
         m *= self.beta1
         m += (1 - self.beta1) * grad
         v *= self.beta2

@@ -82,6 +82,10 @@ class Van:
 
         self.my_id: int = -1
         self.is_scheduler = my_role == Role.SCHEDULER
+        # True when the scheduler handed us a dead node's slot (reference:
+        # is_recovery, postoffice.h:161) — recovering nodes skip startup
+        # barriers (the survivors won't join them again)
+        self.is_recovery = False
         self.ready = threading.Event()
         self.stopped = threading.Event()
 

@@ -1,0 +1,222 @@
+"""In-process pseudo-distributed HiPS topologies.
+
+The reference documents single-host pseudo-distributed deployment by
+spawning one OS process per role (reference:
+docs/source/pseudo-distributed-deployment.rst, scripts/cpu/*.sh). Because
+our Postoffice/Van are instance-scoped (no process-global singletons,
+unlike ps-lite), a whole multi-party HiPS cluster can also run inside ONE
+process on threads — every protocol byte still crosses real loopback
+sockets through the real transport. Used by bench.py (infra roles on CPU
+threads, worker compute on the accelerator) and available to users for
+experimentation without launch scripts.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Callable, List, Optional
+
+from geomx_tpu.config import Config
+from geomx_tpu.kvstore.dist import KVStoreDist
+from geomx_tpu.kvstore.server import KVStoreDistServer
+from geomx_tpu.ps import base as psbase
+from geomx_tpu.ps.message import Role
+from geomx_tpu.ps.postoffice import Postoffice
+
+__all__ = ["free_port", "InProcessHiPS"]
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class InProcessHiPS:
+    """A live HiPS cluster on threads: a central party (global scheduler,
+    ``num_global_servers`` global servers, master worker, scheduler) plus
+    ``num_parties`` data parties of (scheduler, ``servers_per_party``
+    servers, ``workers_per_party`` workers).
+
+    ``start()`` returns once every KVStore constructed; ``workers`` holds
+    the party workers (rank-ordered per party), ``master`` the master
+    worker. ``stop()`` runs the full shutdown cascade and re-raises any
+    node's error.
+    """
+
+    def __init__(self, num_parties: int = 2, workers_per_party: int = 1,
+                 num_global_servers: int = 1, servers_per_party: int = 1,
+                 sync_global: bool = True, use_hfa: bool = False,
+                 hfa_k2: int = 1, enable_central_worker: bool = False,
+                 bigarray_bound: int = 1_000_000,
+                 extra_cfg: Optional[dict] = None):
+        self.gport = free_port()
+        self.cports = [free_port() for _ in range(num_parties + 1)]
+        self.num_parties = num_parties
+        self.wpp = workers_per_party
+        self.ngs = num_global_servers
+        self.spp = servers_per_party
+        self.ngw = num_parties * servers_per_party
+        self.num_all = num_parties * workers_per_party
+        self.bigarray_bound = bigarray_bound
+        self.use_hfa = use_hfa
+        self.hfa_k2 = hfa_k2
+        self.ecw = enable_central_worker
+        self.sync_global = sync_global
+        self.extra_cfg = dict(extra_cfg or {})
+        self.threads: List[threading.Thread] = []
+        self.servers: List[KVStoreDistServer] = []
+        self.workers: List[KVStoreDist] = []
+        self.master: Optional[KVStoreDist] = None
+        self.errors: List[BaseException] = []
+
+    # -- wiring ----------------------------------------------------------
+
+    def _common(self, **kw) -> Config:
+        base = dict(
+            ps_global_root_uri="127.0.0.1", ps_global_root_port=self.gport,
+            num_global_workers=self.ngw, num_global_servers=self.ngs,
+            num_all_workers=self.num_all, use_hfa=self.use_hfa,
+            hfa_k2=self.hfa_k2, enable_central_worker=self.ecw,
+            bigarray_bound=self.bigarray_bound,
+        )
+        base.update(self.extra_cfg)
+        base.update(kw)
+        return Config(**base)
+
+    def _spawn(self, fn: Callable, *args) -> None:
+        def runner():
+            try:
+                fn(*args)
+            except BaseException as e:  # noqa: BLE001 — surfaced in stop()
+                self.errors.append(e)
+
+        t = threading.Thread(target=runner, daemon=True)
+        t.start()
+        self.threads.append(t)
+
+    def _run_sched(self, root_port: int, is_global: bool, nw: int,
+                   ns: int) -> None:
+        po = Postoffice(
+            my_role=Role.SCHEDULER, is_global=is_global,
+            root_uri="127.0.0.1", root_port=root_port,
+            num_workers=nw, num_servers=ns, cfg=Config(**self.extra_cfg),
+        )
+        po.start(60.0)
+        po.barrier(psbase.ALL_GROUP, timeout=120.0)    # startup round
+        po.barrier(psbase.ALL_GROUP, timeout=600.0)    # exit round
+        po.van.stop()
+
+    def start(self, sync_global: Optional[bool] = None) -> "InProcessHiPS":
+        if sync_global is not None:
+            self.sync_global = sync_global
+        self._spawn(self._run_sched, self.gport, True, self.ngw, self.ngs)
+        self._spawn(self._run_sched, self.cports[0], False, 1, self.ngs)
+        for _ in range(self.ngs):
+            cfg = self._common(
+                role="server", role_global="global_server",
+                ps_root_uri="127.0.0.1", ps_root_port=self.cports[0],
+                num_workers=1, num_servers=self.ngs,
+            )
+            srv = KVStoreDistServer(cfg)
+            self.servers.append(srv)
+            self._spawn(srv.run)
+        worker_boxes = []
+        for p in range(self.num_parties):
+            port = self.cports[p + 1]
+            self._spawn(self._run_sched, port, False, self.wpp, self.spp)
+            for _ in range(self.spp):
+                cfg = self._common(
+                    role="server",
+                    ps_root_uri="127.0.0.1", ps_root_port=port,
+                    num_workers=self.wpp, num_servers=self.spp,
+                )
+                srv = KVStoreDistServer(cfg)
+                self.servers.append(srv)
+                self._spawn(srv.run)
+            for _ in range(self.wpp):
+                wcfg = self._common(
+                    role="worker",
+                    ps_root_uri="127.0.0.1", ps_root_port=port,
+                    num_workers=self.wpp, num_servers=self.spp,
+                )
+                box: list = []
+                worker_boxes.append(box)
+                self._spawn(lambda b=box, c=wcfg: b.append(
+                    KVStoreDist(sync_global=self.sync_global, cfg=c)))
+        mcfg = self._common(
+            role="worker", is_master_worker=True,
+            ps_root_uri="127.0.0.1", ps_root_port=self.cports[0],
+            num_workers=1, num_servers=self.ngs,
+        )
+        mbox: list = []
+        self._spawn(lambda: mbox.append(
+            KVStoreDist(sync_global=self.sync_global, cfg=mcfg)))
+        for _ in range(1200):
+            if self.errors:
+                raise self.errors[0]
+            if len(mbox) == 1 and all(len(b) == 1 for b in worker_boxes):
+                break
+            threading.Event().wait(0.1)
+        if len(mbox) != 1 or not all(len(b) == 1 for b in worker_boxes):
+            raise TimeoutError("in-process topology failed to start")
+        self.master = mbox[0]
+        self.workers = [b[0] for b in worker_boxes]
+        return self
+
+    def run_workers(self, fn: Callable[[KVStoreDist], None],
+                    include_master: Optional[Callable] = None,
+                    timeout: float = 600.0) -> None:
+        """Run ``fn(kv)`` concurrently on every party worker (each node
+        acts independently in production; tests/benches must too)."""
+        errs: List[BaseException] = []
+
+        def wrap(f, *a):
+            try:
+                f(*a)
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        fns = [(fn, kv) for kv in self.workers]
+        if include_master is not None:
+            fns.append((include_master, self.master))
+        ts = [threading.Thread(target=wrap, args=(f, *a), daemon=True)
+              for f, *a in fns]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout)
+        if errs:
+            raise errs[0]
+        hung = sum(t.is_alive() for t in ts)
+        if hung:
+            raise TimeoutError(
+                f"{hung} worker(s) still running after {timeout}s")
+
+    def stop(self) -> None:
+        closers = [w for w in self.workers]
+        if self.master is not None:
+            closers.append(self.master)
+        errs: List[BaseException] = []
+
+        def close(kv):
+            try:
+                kv.close()
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=close, args=(kv,), daemon=True)
+              for kv in closers]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        for t in self.threads:
+            t.join(30)
+        if self.errors:
+            raise self.errors[0]
+        if errs:
+            raise errs[0]

@@ -8,9 +8,7 @@ instance-scoped, no subprocesses or env vars are needed — configs are
 passed explicitly.
 """
 
-import socket
 import threading
-from typing import List
 
 import numpy as np
 import pytest
@@ -22,149 +20,17 @@ from geomx_tpu.optimizer import SGD, Adam
 from geomx_tpu.ps import base as psbase
 from geomx_tpu.ps.message import Role
 from geomx_tpu.ps.postoffice import Postoffice
+from geomx_tpu.simulate import InProcessHiPS, free_port  # noqa: F401
 
 
-def free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+class Topology(InProcessHiPS):
+    """The product in-process topology (geomx_tpu.simulate.InProcessHiPS)
+    with test-suite defaults: 2 workers per party, like the reference's
+    12-process demo (scripts/cpu/run_vanilla_hips.sh)."""
 
-
-class Topology:
-    """Builds and tears down a HiPS topology of in-process nodes."""
-
-    def __init__(self, num_parties=2, workers_per_party=2, num_global_servers=1,
-                 servers_per_party=1, use_hfa=False, hfa_k2=1,
-                 enable_central_worker=False, bigarray_bound=1000000,
-                 extra_cfg=None):
-        self.gport = free_port()
-        self.cports = [free_port() for _ in range(num_parties + 1)]  # [0]=central
-        self.num_parties = num_parties
-        self.wpp = workers_per_party
-        self.ngs = num_global_servers
-        self.spp = servers_per_party
-        self.ngw = num_parties * servers_per_party
-        self.num_all = num_parties * workers_per_party
-        self.bigarray_bound = bigarray_bound
-        self.use_hfa = use_hfa
-        self.hfa_k2 = hfa_k2
-        self.ecw = enable_central_worker
-        self.extra_cfg = dict(extra_cfg or {})
-        self.threads: List[threading.Thread] = []
-        self.servers: List[KVStoreDistServer] = []
-        self.workers: List[KVStoreDist] = []
-        self.master: KVStoreDist = None
-        self.errors: List[BaseException] = []
-
-    def _common(self, **kw) -> Config:
-        base = dict(
-            ps_global_root_uri="127.0.0.1", ps_global_root_port=self.gport,
-            num_global_workers=self.ngw, num_global_servers=self.ngs,
-            num_all_workers=self.num_all, use_hfa=self.use_hfa,
-            hfa_k2=self.hfa_k2, enable_central_worker=self.ecw,
-            bigarray_bound=self.bigarray_bound,
-        )
-        base.update(self.extra_cfg)
-        base.update(kw)
-        return Config(**base)
-
-    def _spawn(self, fn, *args):
-        def runner():
-            try:
-                fn(*args)
-            except BaseException as e:  # noqa: BLE001 — surface in test
-                self.errors.append(e)
-
-        t = threading.Thread(target=runner, daemon=True)
-        t.start()
-        self.threads.append(t)
-        return t
-
-    def _run_sched(self, root_port, is_global, nw, ns):
-        po = Postoffice(
-            my_role=Role.SCHEDULER, is_global=is_global,
-            root_uri="127.0.0.1", root_port=root_port,
-            num_workers=nw, num_servers=ns, cfg=Config(**self.extra_cfg),
-        )
-        po.start(60.0)
-        po.barrier(psbase.ALL_GROUP, timeout=60.0)    # startup round
-        po.barrier(psbase.ALL_GROUP, timeout=300.0)   # exit round
-        po.van.stop()
-
-    def start(self, sync_global=True):
-        # global scheduler
-        self._spawn(self._run_sched, self.gport, True, self.ngw, self.ngs)
-        # central party scheduler (1 worker = master, 1 server = global server)
-        self._spawn(self._run_sched, self.cports[0], False, 1, self.ngs)
-        # global server(s) = central party server(s)
-        for _ in range(self.ngs):
-            cfg = self._common(
-                role="server", role_global="global_server",
-                ps_root_uri="127.0.0.1", ps_root_port=self.cports[0],
-                num_workers=1, num_servers=self.ngs,
-            )
-            srv = KVStoreDistServer(cfg)
-            self.servers.append(srv)
-            self._spawn(srv.run)
-        # party schedulers + servers + workers
-        worker_boxes = []
-        for p in range(self.num_parties):
-            port = self.cports[p + 1]
-            self._spawn(self._run_sched, port, False, self.wpp, self.spp)
-            for _ in range(self.spp):
-                cfg = self._common(
-                    role="server",
-                    ps_root_uri="127.0.0.1", ps_root_port=port,
-                    num_workers=self.wpp, num_servers=self.spp,
-                )
-                srv = KVStoreDistServer(cfg)
-                self.servers.append(srv)
-                self._spawn(srv.run)
-            for _ in range(self.wpp):
-                wcfg = self._common(
-                    role="worker",
-                    ps_root_uri="127.0.0.1", ps_root_port=port,
-                    num_workers=self.wpp, num_servers=self.spp,
-                )
-                box = []
-                worker_boxes.append(box)
-                self._spawn(lambda b=box, c=wcfg, s=sync_global:
-                            b.append(KVStoreDist(sync_global=s, cfg=c)))
-        # master worker
-        mcfg = self._common(
-            role="worker", is_master_worker=True,
-            ps_root_uri="127.0.0.1", ps_root_port=self.cports[0],
-            num_workers=1, num_servers=self.ngs,
-        )
-        mbox = []
-        self._spawn(lambda: mbox.append(KVStoreDist(sync_global=sync_global,
-                                                    cfg=mcfg)))
-        # wait for all kvstores to construct
-        for _ in range(600):
-            if self.errors:
-                raise self.errors[0]
-            if len(mbox) == 1 and all(len(b) == 1 for b in worker_boxes):
-                break
-            threading.Event().wait(0.1)
-        assert len(mbox) == 1, "master worker failed to start"
-        assert all(len(b) == 1 for b in worker_boxes), "workers failed to start"
-        self.master = mbox[0]
-        self.workers = [b[0] for b in worker_boxes]
-        return self
-
-    def stop(self):
-        # closes must run concurrently: each member joins the exit barrier
-        # (in production every process closes independently)
-        closers = [w.close for w in self.workers]
-        if self.master is not None:
-            closers.append(self.master.close)
-        _parallel(closers)
-        for t in self.threads:
-            t.join(30)
-        if self.errors:
-            raise self.errors[0]
+    def __init__(self, num_parties=2, workers_per_party=2, **kw):
+        super().__init__(num_parties=num_parties,
+                         workers_per_party=workers_per_party, **kw)
 
 
 def _parallel(fns):

@@ -1,0 +1,210 @@
+"""Failure detection + elastic recovery, end-to-end.
+
+Reference behavior (3rdparty/ps-lite/src/van.cc:176-193): when a node
+re-registers and a registered node of the same role has missed its
+heartbeats, the scheduler hands the dead slot's id to the newcomer with
+``is_recovery=True`` and re-broadcasts the topology; recovering nodes
+skip startup barriers (kvstore_dist.h:63). Server state is NOT persisted
+(SURVEY.md §5.4) — resume after a server death is re-init + recovery.
+
+These tests kill a node mid-training (hard van stop — no goodbye), wait
+for heartbeat lapse, revive it, and assert id handover plus correct
+values on resumed training. Single-tier PS topology (the reference's
+global-tier recovery is explicitly unimplemented: van.cc:224 TODO).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from geomx_tpu.config import Config
+from geomx_tpu.kvstore.dist import KVStoreDist
+from geomx_tpu.kvstore.server import KVStoreDistServer
+from geomx_tpu.optimizer import SGD
+from geomx_tpu.ps import base as psbase
+from geomx_tpu.ps.message import Role
+from geomx_tpu.ps.postoffice import Postoffice
+from geomx_tpu.simulate import free_port
+from tests.test_hips import _parallel
+
+HB = {"heartbeat_interval_s": 0.2, "heartbeat_timeout_s": 1.0}
+
+
+class SingleTier:
+    """scheduler + 1 server + 2 workers with fast heartbeats."""
+
+    def __init__(self):
+        self.port = free_port()
+        self.threads = []
+        self.errors = []
+        self.sched_po = None
+        self.server = None
+        self.workers = []
+
+    def _run(self, fn):
+        def w():
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001
+                self.errors.append(e)
+
+        t = threading.Thread(target=w, daemon=True)
+        t.start()
+        self.threads.append(t)
+
+    def _cfg(self, **kw):
+        base = dict(ps_root_uri="127.0.0.1", ps_root_port=self.port,
+                    num_workers=2, num_servers=1, **HB)
+        base.update(kw)
+        return Config(**base)
+
+    def start(self):
+        self.sched_po = Postoffice(
+            my_role=Role.SCHEDULER, is_global=False,
+            root_uri="127.0.0.1", root_port=self.port,
+            num_workers=2, num_servers=1, cfg=Config(**HB))
+
+        def sched():
+            self.sched_po.start(60)
+            self.sched_po.barrier(psbase.ALL_GROUP, timeout=60)
+            self.sched_po.barrier(psbase.ALL_GROUP, timeout=600)
+            self.sched_po.van.stop()
+
+        self._run(sched)
+        self.server = KVStoreDistServer(self._cfg(role="server"))
+        self._run(self.server.run)
+        boxes = [[], []]
+        for i in range(2):
+            self._run(lambda b=boxes[i]: b.append(
+                KVStoreDist(cfg=self._cfg(role="worker"))))
+        for _ in range(300):
+            if self.errors:
+                raise self.errors[0]
+            if all(len(b) == 1 for b in boxes):
+                break
+            time.sleep(0.1)
+        assert all(len(b) == 1 for b in boxes), "workers failed to start"
+        self.workers = [b[0] for b in boxes]
+        return self
+
+
+def _round(kv, key, w0, expect):
+    kv.push(key, np.ones_like(w0))
+    out = np.zeros_like(w0)
+    kv.pull(key, out=out)
+    kv.wait()
+    np.testing.assert_allclose(out, expect)
+
+
+def test_worker_dies_and_recovers_mid_training():
+    topo = SingleTier().start()
+    w0 = np.full(12, 10.0, np.float32)
+    try:
+        rank0 = next(kv for kv in topo.workers if kv.rank == 0)
+        victim = next(kv for kv in topo.workers if kv.rank == 1)
+        rank0.set_optimizer(SGD(learning_rate=1.0))
+        _parallel([lambda kv=kv: kv.init(0, w0) for kv in topo.workers])
+
+        # round 1: everyone alive
+        _parallel([lambda kv=kv: _round(kv, 0, w0, w0 - 2.0)
+                   for kv in topo.workers])
+
+        # hard-kill the rank-1 worker (no goodbye, no barrier)
+        dead_id = victim.po.my_id
+        victim._closed = True          # disarm its atexit close
+        victim.po.van.stop()
+
+        # heartbeat lapse -> scheduler marks it dead
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if dead_id in topo.sched_po.van.dead_nodes():
+                break
+            time.sleep(0.1)
+        assert dead_id in topo.sched_po.van.dead_nodes()
+
+        # the survivor pushes round 2 and blocks on the missing peer
+        results = []
+
+        def survivor():
+            _round(rank0, 0, w0, w0 - 4.0)
+            results.append("survivor")
+
+        t = threading.Thread(target=survivor, daemon=True)
+        t.start()
+
+        # revive: a fresh worker re-registers and takes the dead slot
+        revived = KVStoreDist(cfg=topo._cfg(role="worker"))
+        assert revived.po.van.is_recovery, "scheduler did not hand over slot"
+        assert revived.po.my_id == dead_id
+        assert revived.rank == 1
+        revived.init(0, w0)            # key info only; store already live
+        _round(revived, 0, w0, w0 - 4.0)
+        t.join(60)
+        assert results == ["survivor"], "survivor did not complete the round"
+
+        # round 3 with the recovered pair
+        _parallel([lambda kv=kv: _round(kv, 0, w0, w0 - 6.0)
+                   for kv in (rank0, revived)])
+        topo.workers = [rank0, revived]
+    finally:
+        _parallel([kv.close for kv in topo.workers])
+        for t in topo.threads:
+            t.join(30)
+        if topo.errors:
+            raise topo.errors[0]
+
+
+def test_server_dies_and_recovers_mid_training():
+    """Server store is volatile (reference: SURVEY §5.4): after the slot
+    handover, workers re-init and re-ship the optimizer, then training
+    resumes from the re-initialized weights."""
+    topo = SingleTier().start()
+    w0 = np.full(8, 4.0, np.float32)
+    try:
+        rank0 = next(kv for kv in topo.workers if kv.rank == 0)
+        rank0.set_optimizer(SGD(learning_rate=1.0))
+        _parallel([lambda kv=kv: kv.init(0, w0) for kv in topo.workers])
+        _parallel([lambda kv=kv: _round(kv, 0, w0, w0 - 2.0)
+                   for kv in topo.workers])
+
+        dead_id = topo.server.po_local.my_id
+        topo.server._stop.set()        # stop the run loop...
+        topo.server.po_local.van.stop()  # ...and crash the van (no barrier)
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if dead_id in topo.sched_po.van.dead_nodes():
+                break
+            time.sleep(0.1)
+        assert dead_id in topo.sched_po.van.dead_nodes()
+
+        revived = KVStoreDistServer(topo._cfg(role="server"))
+        rt = threading.Thread(target=revived.run, daemon=True)
+        rt.start()
+        for _ in range(100):
+            if revived.po_local.van.ready.is_set():
+                break
+            time.sleep(0.1)
+        assert revived.po_local.van.is_recovery
+        assert revived.po_local.my_id == dead_id
+
+        # resume: re-init (store was volatile), re-ship the optimizer
+        rank0.set_optimizer(SGD(learning_rate=1.0))
+        _parallel([lambda kv=kv: kv.init(0, w0) for kv in topo.workers])
+        _parallel([lambda kv=kv: _round(kv, 0, w0, w0 - 2.0)
+                   for kv in topo.workers])
+        topo.server = revived
+    finally:
+        _parallel([kv.close for kv in topo.workers])
+        for t in topo.threads:
+            t.join(30)
+        if topo.errors:
+            raise topo.errors[0]
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))

