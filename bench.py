@@ -140,7 +140,7 @@ def bench_hips():
 
         rounds = [0, 0]           # per-worker completed rounds
         accs = [0.0, 0.0]
-        stop = threading.Event()
+        stop_round = [None]       # set to a round count to end phase B
         phase_b = threading.Event()
         phase_a_done = [False, False]
 
@@ -181,9 +181,13 @@ def bench_hips():
             phase_a_done[widx] = True
             if all(phase_a_done):
                 phase_b.set()
-            # phase B: timed free-run on cached batches (steady state)
+            # phase B: timed free-run on cached batches (steady state).
+            # Exit at an agreed ROUND COUNT, not on the raw stop flag —
+            # rounds are barrier-synchronized, so one worker stopping a
+            # round earlier than the other would strand the peer in a
+            # round the stopped worker never joins
             i = 0
-            while not stop.is_set():
+            while stop_round[0] is None or rounds[widx] < stop_round[0]:
                 X, y = batches[i % len(batches)]
                 one_round(X, y)
                 rounds[widx] += 1
@@ -226,7 +230,7 @@ def bench_hips():
                     "HiPS steady-state stalled: no rounds completed in a "
                     "trial window — refusing to publish a bogus number")
             per_trial.append(made * bs / (time.perf_counter() - t0))
-        stop.set()
+        stop_round[0] = max(rounds) + 2
         runner.join(120.0)
         return {"img_s": statistics.median(per_trial),
                 "acc": float(min(accs)), "trials": [round(x, 1)

@@ -32,6 +32,8 @@ def main():
     parser.add_argument("-lr", "--learning-rate", type=float, default=0.001)
     parser.add_argument("-bs", "--batch-size", type=int, default=32)
     parser.add_argument("-ds", "--data-slice-idx", type=int, default=0)
+    parser.add_argument("-dt", "--data-type", type=str, default="mnist",
+                        choices=["mnist", "fashion-mnist", "cifar10"])
     parser.add_argument("-ep", "--epoch", type=int, default=5)
     parser.add_argument("-ms", "--mixed-sync", action="store_true")
     parser.add_argument("-dc", "--dcasgd", action="store_true")
@@ -66,8 +68,9 @@ def main():
     my_rank = kv.rank
     time.sleep(1)  # let configuration commands land (reference: cnn.py:86)
 
+    input_shape = (32, 32, 3) if args.data_type == "cifar10" else (28, 28, 1)
     leaves, _treedef, grad_step, eval_step = build_model_and_step(
-        args.batch_size)
+        args.batch_size, input_shape=input_shape)
 
     start_epoch = 0
     resume_iters = 0
@@ -96,7 +99,7 @@ def main():
 
     train_iter, test_iter, _, _ = load_data(
         args.batch_size, num_all_workers, args.data_slice_idx,
-        split_by_class=args.split_by_class)
+        data_type=args.data_type, split_by_class=args.split_by_class)
 
     begin_time = time.time()
     global_iters = resume_iters + 1 if args.checkpoint_prefix else 1

@@ -21,7 +21,8 @@ from geomx_tpu.models import create_cnn
 
 
 def build_model_and_step(batch_size: int, compute_dtype=jnp.float32,
-                         num_classes: int = 10):
+                         num_classes: int = 10,
+                         input_shape=(28, 28, 1)):
     """Returns (param_leaves, treedef, grad_step, eval_step).
 
     grad_step(leaves, X, y) -> (loss, grad_leaves); mean-normalized grads
@@ -30,7 +31,7 @@ def build_model_and_step(batch_size: int, compute_dtype=jnp.float32,
     """
     model = create_cnn(num_classes=num_classes, compute_dtype=compute_dtype)
     rng = jax.random.PRNGKey(42)  # same init on every worker process
-    params = model.init(rng, jnp.zeros((1, 28, 28, 1), jnp.float32))
+    params = model.init(rng, jnp.zeros((1, *input_shape), jnp.float32))
     leaves, treedef = jax.tree_util.tree_flatten(params)
 
     def loss_fn(leaf_list, X, y):

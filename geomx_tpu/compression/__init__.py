@@ -189,6 +189,27 @@ def _generic_decompress(tag, val, aux, orig_len):
         return val
     if tag == "fp16":
         return val.astype(np.float32)
+    if tag == "rsp":
+        # row-sparse push (reference: EncodeRowSparseKey,
+        # kvstore_dist.h:906): aux = row ids, val = those rows flattened;
+        # scatter-ADD into a dense delta so overlapping rows from
+        # different workers aggregate by sum
+        ids = np.asarray(aux, dtype=np.int64).ravel()
+        out = np.zeros(orig_len, dtype=np.float32)
+        if ids.size:
+            rows = np.asarray(val, dtype=np.float32).reshape(ids.size, -1)
+            row_len = rows.shape[1]
+            n_rows = orig_len // row_len
+            ok = (ids >= 0) & (ids < n_rows)
+            if not ok.all():
+                import logging
+
+                logging.getLogger("geomx.compression").warning(
+                    "row-sparse push: dropping %d out-of-range row ids "
+                    "(key has %d rows)", int((~ok).sum()), n_rows)
+                ids, rows = ids[ok], rows[ok]
+            np.add.at(out.reshape(n_rows, row_len), ids, rows)
+        return out
     if tag == "bsc":
         assert aux is not None, "bsc payload missing index aux array"
         return bsc_decompress(val, aux, orig_len)
@@ -225,14 +246,19 @@ class BSCCompressor(Compressor):
         self._u: Dict = {}
         self._v: Dict = {}
         self._rng = np.random.default_rng(42)
+        # the boundary-sampling Generator is shared across keys, and
+        # per-key-locked server threads compress different keys
+        # concurrently; numpy Generators are not thread-safe
+        self._rng_lock = __import__("threading").Lock()
 
     def compress_push(self, arr, state_key=None):
         if state_key not in self._u:
             self._u[state_key] = np.zeros(arr.size, dtype=np.float32)
             self._v[state_key] = np.zeros(arr.size, dtype=np.float32)
-        values, indices = bsc_compress(
-            arr.astype(np.float32), self._u[state_key], self._v[state_key],
-            self.threshold, self._rng)
+        with self._rng_lock:
+            values, indices = bsc_compress(
+                arr.astype(np.float32), self._u[state_key],
+                self._v[state_key], self.threshold, self._rng)
         return values, indices, "bsc"
 
     def compress_pull(self, tag, arr, factor):

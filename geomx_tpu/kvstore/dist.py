@@ -358,6 +358,110 @@ class KVStoreDist(KVStore):
             return buf.reshape(info.shape).astype(info.dtype, copy=False)
         return None
 
+    # -- row-sparse (reference: kvstore.h:59 PullRowSparse,
+    # kvstore_dist.h:906 EncodeRowSparseKey) -----------------------------
+    # Wire format: tag "rsp"; aux carries the row ids, vals the touched
+    # rows flattened, lens the row length. The server scatters pushes to
+    # a dense delta (so overlapping rows sum across workers) and gathers
+    # pulls. Row-sparse keys must live on ONE server shard — init them
+    # below MXNET_KVSTORE_BIGARRAY_BOUND or raise it (the reference's
+    # EncodeRowSparseKey also pins whole rows to single servers).
+
+    def _rsp_info(self, key: int, row_len: int):
+        info = self._key_info.get(key)
+        assert info is not None, f"row-sparse use of key {key} before init"
+        assert len(info.shards) == 1, \
+            "row-sparse keys must not be sharded (raise bigarray_bound)"
+        assert info.total % row_len == 0
+        return info
+
+    def push_row_sparse(self, key, row_ids, values,
+                        priority: int = 0) -> None:
+        """Push only the touched rows of a 2-D key (embedding-style
+        updates); rows aggregate by sum across workers."""
+        ids = np.asarray(row_ids, dtype=np.int64).ravel()
+        rows = np.ascontiguousarray(values, dtype=np.float32)
+        rows = rows.reshape(ids.size, -1) if ids.size else rows.reshape(0, 1)
+        info = self._rsp_info(key, rows.shape[1] if ids.size else 1)
+        n_rows = info.total // rows.shape[1] if ids.size else 0
+        if ids.size and (ids.min() < 0 or ids.max() >= n_rows):
+            raise IndexError(
+                f"push_row_sparse: row ids out of range for key {key} "
+                f"({n_rows} rows)")
+        sh = info.shards[0]
+        with self._lock:
+            self._push_acks_left[key] = self._push_acks_left.get(key, 0) + 1
+        self._track(1, key)
+        kvs = KVPairs(keys=[key], vals=[rows.ravel()], aux=[ids],
+                      offsets=[sh.offset], totals=[sh.total],
+                      lens=[sh.length], compr="rsp")
+        self.kvw.push(kvs, sh.server_rank, priority=priority,
+                      cb=lambda ts, kk=key: self._on_push_ack(kk, ts))
+
+    def pull_row_sparse(self, key, row_ids, priority: int = 0,
+                        timeout: float = 300.0) -> np.ndarray:
+        """Gather specific rows; blocking (ordered after this key's push
+        acks, like dense pulls). Returns an (n_rows, row_len) array."""
+        ids = np.asarray(row_ids, dtype=np.int64).ravel()
+        info = self._key_info.get(key)
+        assert info is not None, f"pull_row_sparse of key {key} before init"
+        assert len(info.shape) == 2, "row-sparse keys must be 2-D"
+        row_len = info.shape[-1]
+        self._rsp_info(key, row_len)
+        if ids.size and (ids.min() < 0 or ids.max() >= info.shape[0]):
+            raise IndexError(
+                f"pull_row_sparse: row ids out of range for key {key} "
+                f"({info.shape[0]} rows)")
+        sh = info.shards[0]
+        out = np.zeros((ids.size, row_len), np.float32)
+        done = threading.Event()
+        self._track(1, key)
+
+        def on_data(ts):
+            fail = self.kvw.take_failure(ts)
+            if fail is not None:
+                with self._lock:
+                    self._transport_errors.append(
+                        f"pull_row_sparse key {key}: {fail}")
+            for kvs in self.kvw.take_response(ts):
+                for i, _k in enumerate(kvs.keys):
+                    data = np.asarray(kvs.vals[i], dtype=np.float32)
+                    got = np.asarray(kvs.aux[i], dtype=np.int64).ravel() \
+                        if kvs.aux[i] is not None else ids
+                    if got.size:
+                        rows = data.reshape(got.size, -1)
+                        if got.size == ids.size and (got == ids).all():
+                            out[:] = rows       # common case: echo order
+                        else:
+                            with self._lock:
+                                self._transport_errors.append(
+                                    f"pull_row_sparse key {key}: server "
+                                    f"served {got.size}/{ids.size} rows")
+                            pos = {int(r): j for j, r in enumerate(got)}
+                            for j, rid in enumerate(ids):
+                                if int(rid) in pos:
+                                    out[j] = rows[pos[int(rid)]]
+            done.set()
+            self._untrack(key)
+
+        def issue():
+            self.kvw.pull([key], sh.server_rank, offsets=[sh.offset],
+                          totals=[sh.total], lens=[row_len],
+                          priority=priority, compr="rsp", aux=[ids],
+                          cb=on_data)
+
+        with self._lock:
+            if self._push_acks_left.get(key, 0) > 0:
+                self._deferred.setdefault(key, []).append(issue)
+                deferred = True
+            else:
+                deferred = False
+        if not deferred:
+            issue()
+        if not done.wait(timeout):
+            raise TimeoutError(f"pull_row_sparse of key {key} timed out")
+        return out
+
     def wait(self, keys=None, timeout: float = 300.0) -> None:
         """Block until outstanding pushes/pulls complete. With ``keys``,
         drain only those keys (reference per-key WaitToRead semantics);

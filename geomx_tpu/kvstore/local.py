@@ -57,6 +57,27 @@ class KVStoreLocal(KVStore):
                 np.copyto(np.asarray(o), r)
         return results[0] if len(results) == 1 else results
 
+    # -- row-sparse (reference: kvstore.h:59 PullRowSparse; row_sparse
+    # storage type of kvstore_local.h) ----------------------------------
+
+    def push_row_sparse(self, key, row_ids, values, priority: int = 0) -> None:
+        """Push only the touched rows of a 2-D key; rows aggregate by sum
+        (then the updater applies, when set)."""
+        w = self._store[key]
+        ids = np.asarray(row_ids, dtype=np.int64).ravel()
+        rows = np.asarray(values, dtype=np.float32).reshape(ids.size, -1)
+        dense = np.zeros_like(w, dtype=np.float32).reshape(
+            -1, rows.shape[1])
+        np.add.at(dense, ids, rows)
+        self.push(key, dense.reshape(w.shape), priority)
+
+    def pull_row_sparse(self, key, row_ids, priority: int = 0) -> np.ndarray:
+        """Gather the requested rows (reference: PullRowSparse). The key
+        must hold a 2-D (rows x row_len) value."""
+        ids = np.asarray(row_ids, dtype=np.int64).ravel()
+        w = np.asarray(self._store[key])
+        return w.reshape(-1, w.shape[-1])[ids].copy()
+
     def set_updater(self, updater) -> None:
         self._updater = updater
 

@@ -122,7 +122,9 @@ class _KeyState:
         self.merged: Optional[np.ndarray] = None
         self.push_reqs: List[Tuple[ReqMeta, KVServer]] = []
         self.deferred_acks: List[Tuple[ReqMeta, KVServer]] = []
-        self.pending_pulls: List[Tuple[ReqMeta, KVServer, int, int]] = []
+        # (req, srv, off, length, compr, aux) — compr/aux retained so a
+        # buffered row-sparse pull keeps its response format when flushed
+        self.pending_pulls: List[Tuple] = []
         self.initialized = False
         # True between a local round completing and its global pull-back
         # being applied; local pulls buffer while set, making the stale
@@ -361,13 +363,16 @@ class KVStoreDistServer:
                                                        val, total)
             elif req.pull:
                 length = kvs.len_of(i)
+                aux = kvs.aux[i] if i < len(kvs.aux) else None
                 if global_store:
                     acts += self._pull_global_store(
-                        req, srv, key, off, length, total, kvs.compr)
+                        req, srv, key, off, length, total, kvs.compr, aux)
                 else:
                     st = self._state(key, off)
                     with st.lock:
-                        acts += self._pull_local_store(req, srv, key, off)
+                        acts += self._pull_local_store(req, srv, key, off,
+                                                       length, kvs.compr,
+                                                       aux)
         for fn in acts:
             fn()
 
@@ -635,39 +640,74 @@ class KVStoreDistServer:
     # pull paths
     # ------------------------------------------------------------------
 
-    def _pull_local_store(self, req, srv, key, off) -> List[Action]:
+    def _pull_local_store(self, req, srv, key, off, length: int = 0,
+                          req_compr: str = "", aux=None) -> List[Action]:
+        # length semantics: dense pulls ask for a range (0 = whole
+        # shard, which is what local-tier workers do); row-sparse pulls
+        # carry the ROW LENGTH there
+        rsp_len = length if req_compr == "rsp" else 0
         st = self._state(key, off)
         if not st.initialized or st.staging:
             # buffered until the in-flight cycle applies fresh params —
             # sync-mode pulls must never be served mid-round (reference
-            # buffered-pull semantics, kvstore_dist_server.h:1146-1167)
-            st.pending_pulls.append((req, srv, off, 0))
+            # buffered-pull semantics, kvstore_dist_server.h:1146-1167).
+            # compr/aux are retained: a flushed row-sparse pull must keep
+            # its row-gather response format
+            st.pending_pulls.append((req, srv, off, rsp_len, req_compr, aux))
             return []
-        return [self._pull_response_action(st, req, srv, key, off, 0, "")]
+        return [self._pull_response_action(st, req, srv, key, off, rsp_len,
+                                           req_compr, aux)]
 
     def _pull_global_store(self, req, srv, key, off, length, total,
-                           req_compr) -> List[Action]:
+                           req_compr, aux=None) -> List[Action]:
         with self._lock:
             total = total or self._key_total.get(key, 0)
         acts: List[Action] = []
         for rng in self._canonical_ranges(key, total):
             req_lo = off
-            req_hi = off + (length or rng.length + rng.offset - off)
+            if req_compr == "rsp":
+                req_hi = rng.offset + rng.length  # row gather: whole shard
+            else:
+                req_hi = off + (length or rng.length + rng.offset - off)
             if req_hi <= rng.offset or req_lo >= rng.offset + rng.length:
                 continue
             st = self._state(key, rng.offset)
             with st.lock:
                 if not st.initialized:
-                    st.pending_pulls.append((req, srv, off, length))
+                    st.pending_pulls.append((req, srv, off, length,
+                                             req_compr, aux))
                     continue
                 acts.append(self._pull_response_action(st, req, srv, key, off,
-                                                       length, req_compr))
+                                                       length, req_compr,
+                                                       aux))
         return acts
 
     def _pull_response_action(self, st: _KeyState, req, srv, key,
                               req_off: int, req_len: int,
-                              req_compr: str) -> Action:
+                              req_compr: str, aux=None) -> Action:
         """Build the response closure for one pull against state ``st``."""
+        if req_compr == "rsp":
+            # row-sparse gather (reference: PullRowSparse, kvstore.h:59):
+            # aux = row ids, req_len = row length; respond with just those
+            # rows + the SERVED ids echoed (out-of-range ids are dropped
+            # here rather than crashing the handler — the client errors on
+            # the mismatch)
+            row_len = max(req_len, 1)
+            ids = np.asarray(aux, dtype=np.int64).ravel() \
+                if aux is not None else np.zeros(0, np.int64)
+            n_rows = st.length // row_len
+            ok = (ids >= 0) & (ids < n_rows)
+            if not ok.all():
+                log.warning("row-sparse pull: dropping %d out-of-range "
+                            "row ids (key %d has %d rows)",
+                            int((~ok).sum()), key, n_rows)
+                ids = ids[ok]
+            gathered = st.stored.reshape(n_rows, row_len)[ids] \
+                if ids.size else np.zeros((0, row_len), np.float32)
+            out = KVPairs(keys=[key], vals=[gathered.ravel().copy()],
+                          aux=[ids], offsets=[st.offset],
+                          totals=[st.total], lens=[row_len], compr="rsp")
+            return lambda: srv.response(req, out)
         if req_len:
             lo = max(req_off, st.offset)
             hi = min(req_off + req_len, st.offset + st.length)
@@ -703,9 +743,12 @@ class KVStoreDistServer:
     def _flush_pulls(self, st: _KeyState, key: int) -> List[Action]:
         acts = []
         pulls, st.pending_pulls = st.pending_pulls, []
-        for req, srv, off, length in pulls:
-            acts.append(self._pull_response_action(st, req, srv, key, off,
-                                                   length, ""))
+        for req, srv, off, length, compr, aux in pulls:
+            # dense flushes drop pull-compression (the fresh store holds
+            # weights); row-sparse keeps its format
+            acts.append(self._pull_response_action(
+                st, req, srv, key, off, length,
+                compr if compr == "rsp" else "", aux))
         return acts
 
     # ------------------------------------------------------------------

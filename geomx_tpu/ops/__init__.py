@@ -246,6 +246,27 @@ def dgt_block_contrib(grad, prev, block_size: int, alpha: float):
 # server-side adapter
 # ---------------------------------------------------------------------------
 
+def _host():
+    """geomx_tpu.compression via sys.modules: these methods run in server
+    handler threads, where a function-local geomx_tpu import can deadlock
+    on the package import lock (compression is guaranteed imported — it
+    is the only constructor of DeviceBSCCompressor)."""
+    import sys
+
+    return sys.modules["geomx_tpu.compression"]
+
+
+_base_compressor = None
+
+
+def _host_base():
+    global _base_compressor
+    if _base_compressor is None:
+        _base_compressor = _host().Compressor()
+    return _base_compressor
+
+
+
 class DeviceBSCCompressor:
     """Drop-in for compression.BSCCompressor with device state/kernels.
 
@@ -279,21 +300,11 @@ class DeviceBSCCompressor:
             return np.asarray(bsc_decompress(
                 np.asarray(val, np.float32), np.asarray(aux, np.int32),
                 orig_len))
-        # resolve via sys.modules: this method runs in server handler
-        # threads, where a function-local geomx_tpu import can deadlock
-        # on the package import lock (compression is always imported —
-        # it is the only constructor of this class)
-        import sys
-
-        return sys.modules["geomx_tpu.compression"]._generic_decompress(
-            tag, val, aux, orig_len)
+        return _host()._generic_decompress(tag, val, aux, orig_len)
 
     def compress_pull(self, tag, arr, factor):
         if tag != "bsc":
-            import sys
-
-            return sys.modules["geomx_tpu.compression"].Compressor(
-            ).compress_pull(tag, arr, factor)
+            return _host_base().compress_pull(tag, arr, factor)
         vals, idx = bsc_pull_compress(
             np.asarray(arr, dtype=np.float32), self.threshold, factor)
         return (np.asarray(vals, dtype=np.float32),

@@ -216,10 +216,12 @@ class KVWorker:
         cmd: int = 0,
         priority: int = 0,
         compr: str = "",
+        aux: Optional[List] = None,
         cb: Optional[Callable[[int], None]] = None,
     ) -> int:
         """ZPull (reference: kv_app.h:324). ``cb`` receives the request
-        timestamp when the response arrives."""
+        timestamp when the response arrives. ``aux`` attaches per-key
+        auxiliary arrays to the REQUEST (row-sparse pulls send row ids)."""
         ts = self.customer.new_request(1, auto_clear=cb is not None)
         with self._lock:
             self._responses[ts] = []
@@ -239,6 +241,7 @@ class KVWorker:
         kvs = KVPairs(
             keys=list(keys),
             vals=[np.zeros(0, np.float32)] * len(keys),
+            aux=list(aux or []),
             offsets=list(offsets or []),
             totals=list(totals or []),
             lens=list(lens or []),

@@ -1,0 +1,75 @@
+"""IO/data layer (reference: src/io/, examples/utils.py:39-118).
+
+mnist/fashion-mnist load from IDX files, cifar10 from python-pickle
+batches; absent files fall back to the deterministic synthetic dataset
+with a LOUD warning (a silently-synthetic "cifar10" run is not a cifar10
+run — round-2 missing #6)."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from geomx_tpu.io.datasets import load_data
+
+
+def _write_cifar_fixture(root, n_train_per_batch=20, n_test=10):
+    d = os.path.join(root, "cifar10", "cifar-10-batches-py")
+    os.makedirs(d)
+    rng = np.random.RandomState(0)
+    for name, n in [(f"data_batch_{i}", n_train_per_batch)
+                    for i in range(1, 6)] + [("test_batch", n_test)]:
+        with open(os.path.join(d, name), "wb") as f:
+            pickle.dump({b"data": rng.randint(0, 256, (n, 3072), np.uint8),
+                         b"labels": list(rng.randint(0, 10, n))}, f)
+
+
+def test_cifar10_real_format(tmp_path):
+    _write_cifar_fixture(str(tmp_path))
+    tr, te, ntr, nte = load_data(10, data_type="cifar10",
+                                 root=str(tmp_path))
+    assert (ntr, nte) == (100, 10)
+    X, y = next(iter(tr))
+    assert X.shape == (10, 32, 32, 3)
+    assert X.dtype == np.float32 and 0.0 <= X.min() and X.max() <= 1.0
+    assert y.dtype == np.int32
+
+
+def test_cifar10_synthetic_fallback_is_loud(tmp_path, caplog):
+    import logging
+
+    from geomx_tpu.io import datasets
+
+    datasets._warned_synthetic.discard("cifar10")
+    with caplog.at_level(logging.WARNING, logger="geomx.io"):
+        tr, _te, _n, _m = load_data(8, data_type="cifar10",
+                                    root=str(tmp_path / "nope"))
+    assert any("SYNTHETIC" in r.message for r in caplog.records)
+    X, _ = next(iter(tr))
+    assert X.shape == (8, 32, 32, 3)   # cifar-shaped synthetic
+
+
+def test_worker_slicing_partitions_data():
+    per = []
+    for widx in range(4):
+        tr, _te, n, _m = load_data(8, num_workers=4, data_slice_idx=widx,
+                                   root="/nonexistent")
+        per.append(n)
+    assert len(set(per)) == 1  # even split
+    with pytest.raises(AssertionError):
+        load_data(8, num_workers=2, data_slice_idx=2, root="/nonexistent")
+
+
+def test_split_by_class_is_non_iid():
+    tr, _te, _n, _m = load_data(64, num_workers=2, data_slice_idx=0,
+                                split_by_class=True, root="/nonexistent")
+    _X, y = next(iter(tr))
+    # class-sorted halves: worker 0 sees only the lower classes
+    assert len(np.unique(y)) <= 6
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))
