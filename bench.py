@@ -116,6 +116,45 @@ def bench_nokv():
     return {"img_s": statistics.median(rates), "acc": float(acc)}
 
 
+
+def _spawn_hips_workers(topo, worker, master_init, ready_evt):
+    """Run the worker fleet on a daemon thread; errors are captured and
+    ready_evt is set so the main thread can re-raise promptly."""
+    errs: list = []
+
+    def _run():
+        try:
+            topo.run_workers(worker, include_master=master_init,
+                             timeout=1800.0)
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+            ready_evt.set()
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    return t, errs
+
+
+def _measure_trials(read_progress, errs, unit_per_tick: int):
+    """TRIALS windows of TRIAL_SECONDS; raises on worker error or stall
+    (never publish a number from a dead topology)."""
+    per_trial = []
+    for _ in range(TRIALS):
+        p0 = read_progress()
+        t0 = time.perf_counter()
+        time.sleep(TRIAL_SECONDS)
+        if errs:
+            raise errs[0]
+        made = read_progress() - p0
+        if made == 0:
+            raise RuntimeError(
+                "steady-state stalled: no progress in a trial window — "
+                "refusing to publish a bogus number")
+        per_trial.append(made * unit_per_tick
+                         / (time.perf_counter() - t0))
+    return per_trial
+
+
 def bench_hips():
     """Framework-in-the-loop: 2 parties x 1 worker, live HiPS topology."""
     import jax.numpy as jnp
@@ -193,8 +232,6 @@ def bench_hips():
                 rounds[widx] += 1
                 i += 1
 
-        runner_err: list = []
-
         def master_init(kv):
             # the master worker initializes the global store and steps
             # aside (reference: cnn.py master path)
@@ -202,39 +239,102 @@ def bench_hips():
                 kv.init(idx, np.array(leaf))
             kv.wait()
 
-        def _run():
-            try:
-                topo.run_workers(worker, include_master=master_init,
-                                 timeout=1800.0)
-            except BaseException as e:  # noqa: BLE001
-                runner_err.append(e)
-                phase_b.set()   # unblock main so the error surfaces
-
-        runner = threading.Thread(target=_run, daemon=True)
-        runner.start()
+        runner, runner_err = _spawn_hips_workers(topo, worker, master_init,
+                                                 phase_b)
         if not phase_b.wait(900.0):
             raise TimeoutError("HiPS accuracy phase did not complete")
         if runner_err:
             raise runner_err[0]
         time.sleep(2.0)  # settle into steady state
-        per_trial = []
-        for _ in range(TRIALS):
-            r0 = rounds[0] + rounds[1]
-            t0 = time.perf_counter()
-            time.sleep(TRIAL_SECONDS)
-            if runner_err:
-                raise runner_err[0]
-            made = rounds[0] + rounds[1] - r0
-            if made == 0:
-                raise RuntimeError(
-                    "HiPS steady-state stalled: no rounds completed in a "
-                    "trial window — refusing to publish a bogus number")
-            per_trial.append(made * bs / (time.perf_counter() - t0))
+        per_trial = _measure_trials(lambda: rounds[0] + rounds[1],
+                                    runner_err, bs)
+        # exit on an agreed ROUND COUNT (rounds are barrier-synchronized;
+        # a raw stop flag could strand one worker in a round its peer
+        # never joins)
         stop_round[0] = max(rounds) + 2
         runner.join(120.0)
         return {"img_s": statistics.median(per_trial),
                 "acc": float(min(accs)), "trials": [round(x, 1)
                                                     for x in per_trial]}
+    finally:
+        topo.stop()
+
+
+def bench_hips_hfa(hfa_k1: int = 4, hfa_k2: int = 2):
+    """HFA flavor of the framework bench: workers take K1 LOCAL optimizer
+    steps per LAN sync, and the party tier crosses the WAN only every K2
+    rounds (reference: cnn_hfa.py + HFA milestone algebra). This is the
+    geo-distributed amortization lever — throughput counts every local
+    step, so it should approach the no-kvstore rate as K1*K2 grows."""
+    import jax
+    import jax.numpy as jnp
+
+    from examples.utils import build_model_and_step
+    from geomx_tpu.io import load_data
+    from geomx_tpu.optimizer import Adam
+    from geomx_tpu.simulate import InProcessHiPS
+
+    topo = InProcessHiPS(num_parties=2, workers_per_party=1,
+                         use_hfa=True, hfa_k2=hfa_k2).start()
+    try:
+        bs = BATCH_PER_WORKER
+        leaves0, _td, grad_step, _eval_step = build_model_and_step(bs)
+        iters = [0, 0]
+        stop_round = [None]
+        started = threading.Event()
+
+        def master_init(kv):
+            for idx, leaf in enumerate(leaves0):
+                kv.init(idx, np.array(leaf))
+            kv.wait()
+
+        def worker(kv):
+            widx = 0 if kv is topo.workers[0] else 1
+            leaves = [np.array(l) for l in leaves0]
+            opt = Adam(learning_rate=1e-3)
+            for idx, leaf in enumerate(leaves):
+                kv.init(idx, leaf)
+                kv.pull(idx, out=leaves[idx])
+            kv.wait()
+            train_iter, _te, _n, _m = load_data(bs, 2, widx)
+            batches = [(jnp.asarray(X), jnp.asarray(y))
+                       for X, y in list(train_iter)[:8]]
+            nlw = kv.num_workers
+            i = 0
+            while stop_round[0] is None or iters[widx] < stop_round[0]:
+                X, y = batches[i % len(batches)]
+                _loss, grads = grad_step(jax.device_put(leaves), X, y)
+                grads = jax.device_get(grads)
+                for idx, g in enumerate(grads):
+                    leaves[idx] = np.asarray(opt.update(
+                        idx, leaves[idx], g)).reshape(leaves[idx].shape)
+                iters[widx] += 1
+                if iters[widx] % hfa_k1 == 0:
+                    for idx in range(len(leaves)):
+                        kv.push(idx, leaves[idx] / nlw, priority=-idx)
+                        kv.pull(idx, out=leaves[idx], priority=-idx)
+                    kv.wait()
+                if iters[widx] >= 3:
+                    started.set()
+                i += 1
+
+        runner, runner_err = _spawn_hips_workers(topo, worker, master_init,
+                                                 started)
+        if not started.wait(900.0):
+            raise TimeoutError("HFA bench did not start")
+        if runner_err:
+            raise runner_err[0]
+        time.sleep(2.0)
+        per_trial = _measure_trials(lambda: iters[0] + iters[1],
+                                    runner_err, bs)
+        # round up to the next K1 boundary so both workers exit on the
+        # same sync cycle
+        top = max(iters) + 2 * hfa_k1
+        stop_round[0] = -(-top // hfa_k1) * hfa_k1
+        runner.join(120.0)
+        return {"img_s": statistics.median(per_trial), "k1": hfa_k1,
+                "k2": hfa_k2,
+                "trials": [round(x, 1) for x in per_trial]}
     finally:
         topo.stop()
 
@@ -327,6 +427,13 @@ def main():
     details["framework_overhead"] = round(
         nokv["img_s"] / max(hips["img_s"], 1e-9), 2)
     details["accuracy_parity"] = round(hips["acc"] - nokv["acc"], 4)
+    try:
+        hfa = bench_hips_hfa()
+        details["hips_hfa_cnn"] = {"img_s": round(hfa["img_s"], 1),
+                                   "k1": hfa["k1"], "k2": hfa["k2"],
+                                   "trials": hfa["trials"]}
+    except Exception as e:  # noqa: BLE001 — secondary metric
+        details["hips_hfa_cnn"] = {"error": str(e)}
     try:
         details["transformer"] = bench_transformer_mfu()
     except Exception as e:  # noqa: BLE001 — secondary metric

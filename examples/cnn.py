@@ -34,6 +34,8 @@ def main():
     parser.add_argument("-ds", "--data-slice-idx", type=int, default=0)
     parser.add_argument("-dt", "--data-type", type=str, default="mnist",
                         choices=["mnist", "fashion-mnist", "cifar10"])
+    parser.add_argument("-m", "--model", type=str, default="cnn",
+                        help="cnn | resnet18 | resnet34 | resnet50 | ...")
     parser.add_argument("-ep", "--epoch", type=int, default=5)
     parser.add_argument("-ms", "--mixed-sync", action="store_true")
     parser.add_argument("-dc", "--dcasgd", action="store_true")
@@ -70,7 +72,7 @@ def main():
 
     input_shape = (32, 32, 3) if args.data_type == "cifar10" else (28, 28, 1)
     leaves, _treedef, grad_step, eval_step = build_model_and_step(
-        args.batch_size, input_shape=input_shape)
+        args.batch_size, input_shape=input_shape, model=args.model)
 
     start_epoch = 0
     resume_iters = 0

@@ -22,35 +22,91 @@ from geomx_tpu.models import create_cnn
 
 def build_model_and_step(batch_size: int, compute_dtype=jnp.float32,
                          num_classes: int = 10,
-                         input_shape=(28, 28, 1)):
+                         input_shape=(28, 28, 1), model: str = "cnn"):
     """Returns (param_leaves, treedef, grad_step, eval_step).
 
     grad_step(leaves, X, y) -> (loss, grad_leaves); mean-normalized grads
     (the reference pushes grad/num_samples, examples/cnn.py:123 — MXNet
     grads are per-batch sums; JAX mean-loss grads are already normalized).
+
+    ``model``: "cnn" (the reference demo net) or a resnet name
+    ("resnet18", "resnet50", ...). ResNet BatchNorm running stats stay
+    WORKER-LOCAL (not pushed through the kvstore) — the reference's
+    kvstore flow treats BN aux states the same way: only optimizer-
+    updated parameters travel.
+
+    Contract note: the resnet-path grad_step/eval_step close over a
+    mutable batch_stats box, so unlike the cnn path they are STATEFUL —
+    do not wrap them in an outer jax.jit and do not share one instance
+    across concurrent workers; call build_model_and_step per worker.
     """
-    model = create_cnn(num_classes=num_classes, compute_dtype=compute_dtype)
     rng = jax.random.PRNGKey(42)  # same init on every worker process
-    params = model.init(rng, jnp.zeros((1, *input_shape), jnp.float32))
-    leaves, treedef = jax.tree_util.tree_flatten(params)
+    if model == "cnn":
+        net = create_cnn(num_classes=num_classes,
+                         compute_dtype=compute_dtype)
+        params = net.init(rng, jnp.zeros((1, *input_shape), jnp.float32))
+        leaves, treedef = jax.tree_util.tree_flatten(params)
 
-    def loss_fn(leaf_list, X, y):
-        p = jax.tree_util.tree_unflatten(treedef, leaf_list)
-        logits = model.apply(p, X)
-        one_hot = jax.nn.one_hot(y, num_classes)
-        loss = -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * one_hot, axis=-1))
-        return loss
+        def loss_fn(leaf_list, X, y):
+            p = jax.tree_util.tree_unflatten(treedef, leaf_list)
+            logits = net.apply(p, X)
+            one_hot = jax.nn.one_hot(y, num_classes)
+            return -jnp.mean(
+                jnp.sum(jax.nn.log_softmax(logits) * one_hot, axis=-1))
 
-    @jax.jit
-    def grad_step(leaf_list, X, y):
-        loss, grads = jax.value_and_grad(loss_fn)(leaf_list, X, y)
-        return loss, grads
+        @jax.jit
+        def grad_step(leaf_list, X, y):
+            loss, grads = jax.value_and_grad(loss_fn)(leaf_list, X, y)
+            return loss, grads
 
-    @jax.jit
-    def eval_step(leaf_list, X, y):
-        p = jax.tree_util.tree_unflatten(treedef, leaf_list)
-        pred = jnp.argmax(model.apply(p, X), axis=-1)
-        return jnp.mean((pred == y).astype(jnp.float32))
+        @jax.jit
+        def eval_step(leaf_list, X, y):
+            p = jax.tree_util.tree_unflatten(treedef, leaf_list)
+            pred = jnp.argmax(net.apply(p, X), axis=-1)
+            return jnp.mean((pred == y).astype(jnp.float32))
+
+    elif model.startswith("resnet"):
+        from geomx_tpu.models import create_resnet
+
+        net = create_resnet(model, num_classes=num_classes,
+                            compute_dtype=compute_dtype)
+        variables = net.init(rng, jnp.zeros((1, *input_shape), jnp.float32))
+        leaves, treedef = jax.tree_util.tree_flatten(variables["params"])
+        state_box = {"batch_stats": variables["batch_stats"]}
+
+        def loss_fn(leaf_list, bstats, X, y):
+            p = jax.tree_util.tree_unflatten(treedef, leaf_list)
+            logits, updates = net.apply(
+                {"params": p, "batch_stats": bstats}, X, train=True,
+                mutable=["batch_stats"])
+            one_hot = jax.nn.one_hot(y, num_classes)
+            loss = -jnp.mean(
+                jnp.sum(jax.nn.log_softmax(logits) * one_hot, axis=-1))
+            return loss, updates["batch_stats"]
+
+        @jax.jit
+        def _grad_step(leaf_list, bstats, X, y):
+            (loss, new_bs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(leaf_list, bstats, X, y)
+            return loss, grads, new_bs
+
+        def grad_step(leaf_list, X, y):
+            loss, grads, state_box["batch_stats"] = _grad_step(
+                leaf_list, state_box["batch_stats"], X, y)
+            return loss, grads
+
+        @jax.jit
+        def _eval_step(leaf_list, bstats, X, y):
+            p = jax.tree_util.tree_unflatten(treedef, leaf_list)
+            logits = net.apply({"params": p, "batch_stats": bstats}, X)
+            pred = jnp.argmax(logits, axis=-1)
+            return jnp.mean((pred == y).astype(jnp.float32))
+
+        def eval_step(leaf_list, X, y):
+            return _eval_step(leaf_list, state_box["batch_stats"], X, y)
+
+    else:
+        raise ValueError(f"unknown model {model!r}")
 
     # writable host copies (np.asarray of a jax array is a read-only view)
     return ([np.array(l, copy=True) for l in leaves], treedef, grad_step,

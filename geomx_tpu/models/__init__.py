@@ -5,3 +5,4 @@ CNN, examples/cnn.py:56-63).
 
 from geomx_tpu.models.cnn import LeNetCNN, create_cnn  # noqa: F401
 from geomx_tpu.models.mlp import MLP  # noqa: F401
+from geomx_tpu.models.resnet import ResNet, create_resnet  # noqa: F401

@@ -272,8 +272,10 @@ class DeviceBSCCompressor:
 
     Per-key momentum (u) and accumulation (v) stay resident on the
     accelerator; only the compressed (values, indices) pair crosses to
-    host for the wire. For >=1M-element keys the device top-k beats the
-    host partition by an order of magnitude (tools/compress_bench.py).
+    host for the wire. Measured on a v5e chip (tools/compress_bench.py):
+    8M-element keys compress 4.9x faster than the host partition (2-bit:
+    9.2x); ~1M-element keys break even when host<->device transfers ride
+    a network tunnel, and win on a TPU-local host.
     """
 
     type_name = "bsc"

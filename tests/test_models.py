@@ -1,0 +1,65 @@
+"""Model zoo shapes + trainability (reference: gluon model_zoo/vision)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from geomx_tpu.models import MLP, create_cnn, create_resnet
+from geomx_tpu.models.transformer import Transformer
+
+
+def test_cnn_shapes():
+    m = create_cnn()
+    p = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+    out = m.apply(p, jnp.zeros((4, 28, 28, 1)))
+    assert out.shape == (4, 10) and out.dtype == jnp.float32
+
+
+@pytest.mark.parametrize("name,params_m", [("resnet18", 11.2),
+                                           ("resnet50", 23.5)])
+def test_resnet_shapes_and_param_counts(name, params_m):
+    m = create_resnet(name, num_classes=10)
+    vars_ = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    n = sum(x.size for x in jax.tree_util.tree_leaves(vars_["params"]))
+    # within 10% of the canonical ImageNet-head counts (small head here)
+    assert abs(n / 1e6 - params_m) / params_m < 0.1, n
+    out = m.apply(vars_, jnp.zeros((2, 32, 32, 3)))
+    assert out.shape == (2, 10)
+
+
+def test_resnet_trains_one_step():
+    m = create_resnet("resnet18")
+    vars_ = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    x = jax.random.uniform(jax.random.PRNGKey(1), (8, 32, 32, 3))
+    y = jax.random.randint(jax.random.PRNGKey(2), (8,), 0, 10)
+
+    def loss_fn(params):
+        logits, updates = m.apply(
+            {"params": params, "batch_stats": vars_["batch_stats"]},
+            x, train=True, mutable=["batch_stats"])
+        oh = jax.nn.one_hot(y, 10)
+        return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * oh, -1))
+
+    loss, grads = jax.jit(jax.value_and_grad(loss_fn))(vars_["params"])
+    assert np.isfinite(float(loss))
+    gnorm = sum(float(jnp.sum(g ** 2))
+                for g in jax.tree_util.tree_leaves(grads))
+    assert gnorm > 0
+
+
+def test_mlp_and_transformer_smoke():
+    mlp = MLP(features=(32, 10))
+    p = mlp.init(jax.random.PRNGKey(0), jnp.zeros((1, 16)))
+    assert mlp.apply(p, jnp.zeros((3, 16))).shape == (3, 10)
+
+    tr = Transformer(vocab=50, dim=32, depth=1, heads=2, max_len=16)
+    toks = jnp.zeros((2, 16), jnp.int32)
+    p = tr.init(jax.random.PRNGKey(0), toks)
+    assert tr.apply(p, toks).shape == (2, 16, 50)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))
