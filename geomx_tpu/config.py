@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Optional
 
 
 def env_str(name: str, default: str = "") -> str:

@@ -19,7 +19,7 @@ import logging
 import os
 import pickle
 import struct
-from typing import Iterator, Optional, Tuple
+from typing import Iterator, Tuple
 
 import numpy as np
 

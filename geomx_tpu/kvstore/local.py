@@ -11,7 +11,7 @@ worker's local device reduction.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict
 
 import numpy as np
 

@@ -67,7 +67,7 @@ from geomx_tpu.kvstore import sharding
 from geomx_tpu.kvstore.base import Command, DATA_INIT
 from geomx_tpu.ps import base as psbase
 from geomx_tpu.ps.kv_app import KVPairs, KVServer, KVWorker, ReqMeta
-from geomx_tpu.ps.message import Message, Meta, Role
+from geomx_tpu.ps.message import Role
 from geomx_tpu.ps.postoffice import Postoffice
 
 log = logging.getLogger("geomx.server")

@@ -32,7 +32,6 @@ from __future__ import annotations
 
 import functools
 import os
-from typing import Optional, Tuple
 
 import numpy as np
 

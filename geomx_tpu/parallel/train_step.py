@@ -12,7 +12,6 @@ touches the WAN.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp

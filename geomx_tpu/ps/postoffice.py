@@ -17,7 +17,7 @@ from typing import Dict, List, Optional, Tuple
 from geomx_tpu import config as cfg_mod
 from geomx_tpu.ps import base
 from geomx_tpu.ps.customer import Customer
-from geomx_tpu.ps.message import Control, Message, Role
+from geomx_tpu.ps.message import Message, Role
 from geomx_tpu.ps.van import Van
 
 log = logging.getLogger("geomx.postoffice")

@@ -1,7 +1,5 @@
 """Checkpoint/resume, metric library, and Trainer tests."""
 
-import threading
-
 import numpy as np
 import pytest
 

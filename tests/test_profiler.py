@@ -8,7 +8,6 @@ kvstore_dist_server.h:383-430).
 """
 
 import json
-import os
 import threading
 
 import numpy as np
