@@ -3,7 +3,11 @@
 Reference: src/kvstore/kvstore.cc:41-82 KVStore::Create and
 python/mxnet/kvstore.py:663 create. Accepted type strings:
 
-- "local" / "device"            — single-process store
+- "local" / "device"            — single-process host store
+- "nccl"                        — single-process multi-device allreduce
+                                  store (reference: kvstore_nccl.h:62);
+                                  on TPU the allreduce is an XLA
+                                  cross-device sum over the local mesh
 - "dist" / "dist_sync" / "dist_sync_device" / "dist_sync_tpu"
                                 — distributed, FSA (both tiers synchronous)
 - "dist_async"                  — distributed, MixedSync (async global tier)
@@ -28,4 +32,8 @@ def create(name: str = "local") -> KVStore:
         if "_async" in tname:
             sync_global = False
         return KVStoreDist(sync_global=sync_global)
+    if tname == "nccl":
+        from geomx_tpu.kvstore.device import KVStoreDeviceAllreduce
+
+        return KVStoreDeviceAllreduce()
     return KVStoreLocal()

@@ -1,0 +1,132 @@
+"""KVStoreDeviceAllreduce — the KVStoreNCCL equivalent.
+
+Plays the role of the reference's single-process multi-device allreduce
+store (reference: src/kvstore/kvstore_nccl.h:62 KVStoreNCCL): ``push``
+takes one gradient PER LOCAL DEVICE, reduces them with a device-side
+collective, applies the optimizer, and ``pull`` serves the (replicated)
+fresh value. On TPU the NCCL allreduce maps to an XLA cross-device sum
+over the local mesh: per-device shards are laid out over a 1-D "dev"
+axis and summed with a jitted reduction, so the traffic rides ICI, not
+host memory.
+
+The store itself stays device-resident: values live as replicated jax
+arrays; ``pull`` only copies to host when the caller asks for numpy.
+For multi-process distributed training use ``dist_*`` stores; for
+in-step DP (the TPU-idiomatic shape) use geomx_tpu.parallel.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from geomx_tpu.kvstore.base import KVStore
+
+
+class KVStoreDeviceAllreduce(KVStore):
+    def __init__(self, devices: Optional[list] = None):
+        super().__init__()
+        import jax
+
+        self._jax = jax
+        self.devices = list(devices or jax.local_devices())
+        self._store: Dict[int, object] = {}   # key -> replicated jax array
+        # host mirror of the stored values, maintained so the (host-side)
+        # updater path never has to download the weight from device
+        self._host: Dict[int, np.ndarray] = {}
+        self._shapes: Dict[int, tuple] = {}
+        self._updater = None
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        self._mesh = Mesh(np.array(self.devices), ("dev",))
+        self._stacked = NamedSharding(self._mesh, P("dev"))
+        self._repl = NamedSharding(self._mesh, P())
+
+        import jax.numpy as jnp
+
+        @jax.jit
+        def _reduce(stacked):
+            # [n_dev, ...] sharded over "dev" -> cross-device sum; XLA
+            # lowers this to the allreduce collective over ICI
+            return jnp.sum(stacked, axis=0)
+
+        self._reduce = _reduce
+
+    @property
+    def type(self) -> str:
+        return "nccl"
+
+    @property
+    def num_devices(self) -> int:
+        return len(self.devices)
+
+    def init(self, key, value) -> None:
+        keys = self._as_key_list(key)
+        values = value if isinstance(value, (list, tuple)) and len(keys) > 1 \
+            else [value]
+        assert len(keys) == len(values), (len(keys), len(values))
+        for k, v in zip(keys, values):
+            assert k not in self._store, f"duplicate init of key {k}"
+            host = np.array(np.asarray(v), dtype=np.float32)
+            arr = self._jax.numpy.asarray(host)
+            self._shapes[k] = arr.shape
+            self._store[k] = self._jax.device_put(arr, self._repl)
+            self._host[k] = host
+
+    def push(self, key, value, priority: int = 0) -> None:
+        """``value``: ONE array per local device (list), or a single
+        array (treated as already reduced)."""
+        keys = self._as_key_list(key)
+        # a per-device gradient LIST for a single key must not be split
+        # across keys — only treat `value` as per-key when there are
+        # multiple keys (same rule as KVStoreLocal)
+        values = value if isinstance(value, (list, tuple)) \
+            and len(keys) > 1 else [value]
+        assert len(keys) == len(values), (len(keys), len(values))
+        for k, v in zip(keys, values):
+            if isinstance(v, (list, tuple)):
+                assert len(v) == len(self.devices), (
+                    f"push of key {k} expects {len(self.devices)} "
+                    f"per-device gradients, got {len(v)}")
+                shards = [self._jax.device_put(
+                    self._jax.numpy.asarray(x)[None], d)
+                    for x, d in zip(v, self.devices)]
+                stacked = self._jax.make_array_from_single_device_arrays(
+                    (len(v), *self._shapes[k]), self._stacked, shards)
+                merged = self._reduce(stacked)
+            else:
+                merged = self._jax.numpy.asarray(np.asarray(v, np.float32))
+            if self._updater is not None:
+                # host-side optimizer: the gradient must come to host,
+                # but the weight reads from the mirror (no download)
+                new_w = np.asarray(self._updater(
+                    k, np.asarray(merged), self._host[k])).reshape(
+                        self._shapes[k]).astype(np.float32)
+                self._host[k] = new_w
+                self._store[k] = self._jax.device_put(
+                    self._jax.numpy.asarray(new_w), self._repl)
+            else:
+                self._store[k] = self._jax.device_put(
+                    merged.reshape(self._shapes[k]), self._repl)
+                self._host[k] = np.asarray(self._store[k])
+
+    def pull(self, key, out=None, priority: int = 0):
+        keys = self._as_key_list(key)
+        results = [np.asarray(self._store[k]) for k in keys]
+        if out is not None:
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for o, r in zip(outs, results):
+                np.copyto(np.asarray(o), r)
+        return results[0] if len(results) == 1 else results
+
+    def pull_device(self, key):
+        """Device-resident pull (no host copy) — the NCCL-store fast path."""
+        return self._store[key]
+
+    def set_updater(self, updater) -> None:
+        self._updater = updater
+
+    def set_optimizer(self, optimizer) -> None:
+        self._updater = optimizer
+        self._optimizer = optimizer

@@ -1,0 +1,71 @@
+"""KVStoreDeviceAllreduce — the KVStoreNCCL equivalent (reference:
+src/kvstore/kvstore_nccl.h:62), on the 8-device virtual CPU mesh."""
+
+import numpy as np
+import pytest
+
+import geomx_tpu as gx
+from geomx_tpu.optimizer import SGD
+
+
+def test_nccl_store_allreduce_and_update():
+    import jax
+
+    kv = gx.kv.create("nccl")
+    assert kv.type == "nccl"
+    assert kv.num_devices == len(jax.local_devices())
+    n = kv.num_devices
+
+    kv.set_optimizer(SGD(learning_rate=1.0))
+    w0 = np.arange(12, dtype=np.float32).reshape(3, 4)
+    kv.init(0, w0)
+
+    # one gradient per device; allreduce = sum -> SGD applies the sum
+    grads = [np.full((3, 4), 0.5, np.float32) for _ in range(n)]
+    kv.push(0, grads)
+    np.testing.assert_allclose(kv.pull(0), w0 - 0.5 * n)
+
+    # device-resident pull keeps it on device
+    dev_val = kv.pull_device(0)
+    assert hasattr(dev_val, "sharding")
+    np.testing.assert_allclose(np.asarray(dev_val), w0 - 0.5 * n)
+
+
+def test_nccl_store_single_array_push_and_out():
+    kv = gx.kv.create("nccl")
+    kv.init(1, np.zeros(8, np.float32))
+    kv.push(1, np.ones(8, np.float32))    # already-reduced push
+    out = np.zeros(8, np.float32)
+    kv.pull(1, out=out)
+    np.testing.assert_allclose(out, np.ones(8))   # no updater: overwrite
+
+
+def test_nccl_store_wrong_device_count_rejected():
+    kv = gx.kv.create("nccl")
+    kv.init(2, np.zeros(4, np.float32))
+    with pytest.raises(AssertionError, match="per-device"):
+        kv.push(2, [np.ones(4, np.float32)] * (kv.num_devices + 1))
+
+
+
+
+def test_nccl_store_single_key_list_push_reduces_all_devices():
+    """Regression (review repro): push([k], per_device_list) must
+    allreduce all devices' gradients, not silently use the first."""
+    kv = gx.kv.create("nccl")
+    n = kv.num_devices
+    kv.init(3, np.zeros(4, np.float32))
+    kv.push([3], [np.ones(4, np.float32)] * n)
+    np.testing.assert_allclose(kv.pull(3), np.full(4, float(n)))
+
+
+def test_nccl_store_init_length_mismatch_rejected():
+    kv = gx.kv.create("nccl")
+    with pytest.raises(AssertionError):
+        kv.init([5, 6], np.zeros(4, np.float32))
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))
