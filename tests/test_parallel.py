@@ -113,3 +113,60 @@ if __name__ == "__main__":
     import sys
 
     sys.exit(pytest.main([__file__, "-x", "-q"]))
+
+
+def test_hierarchical_trainer_geo_dp(devices):
+    """The flagship geo-DP composition: each 'data center' is a DP mesh
+    (4 virtual devices), and the HiPS tiers carry ONE aggregated
+    gradient per key across the WAN (reference replaces the per-worker
+    push/pull loop, examples/cnn.py:121-124). Both workers must see
+    identical post-round parameters."""
+    import threading
+
+    from geomx_tpu.models import MLP
+    from geomx_tpu.optimizer import SGD
+    from geomx_tpu.parallel.train_step import HierarchicalTrainer
+    from tests.test_hips import Topology, _parallel
+
+    topo = Topology(num_parties=2, workers_per_party=1).start(
+        sync_global=True)
+    try:
+        topo.master.set_optimizer(SGD(learning_rate=0.1))
+        meshes = [make_mesh(devices[:4]), make_mesh(devices[4:8])]
+        results = {}
+        lock = threading.Lock()
+
+        def run(kv, mesh):
+            model = MLP(features=(16, 4))
+            dp = DataParallelTrainer(model, optax.sgd(0.1), mesh,
+                                     jnp.zeros((1, 8), jnp.float32),
+                                     num_classes=4)
+            ht = HierarchicalTrainer(dp, kv)
+            # master init path: rank-0 worker of each party pushes
+            ht.init_on_kvstore()
+            rng = np.random.RandomState(0)  # same data on both DCs
+            X = rng.randn(8, 8).astype(np.float32)
+            y = rng.randint(0, 4, 8)
+            losses = [ht.step(X, y) for _ in range(3)]
+            leaves = jax.tree_util.tree_leaves(ht.t.params)
+            with lock:
+                results[id(kv)] = ([np.asarray(l) for l in leaves], losses)
+
+        def master(kv):
+            model = MLP(features=(16, 4))
+            dp = DataParallelTrainer(model, optax.sgd(0.1),
+                                     make_mesh(devices[:1]),
+                                     jnp.zeros((1, 8), jnp.float32),
+                                     num_classes=4)
+            HierarchicalTrainer(dp, kv).init_on_kvstore()
+
+        _parallel([lambda kv=kv, m=m: run(kv, m)
+                   for kv, m in zip(topo.workers, meshes)]
+                  + [lambda: master(topo.master)])
+
+        (l0, losses0), (l1, losses1) = results.values()
+        for a, b in zip(l0, l1):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+        assert all(np.isfinite(losses0))
+    finally:
+        topo.stop()
