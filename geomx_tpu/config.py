@@ -70,6 +70,11 @@ class Config:
     num_global_workers: int = 0         # DMLC_NUM_GLOBAL_WORKER
     num_global_servers: int = 0         # DMLC_NUM_GLOBAL_SERVER
     num_all_workers: int = 1            # DMLC_NUM_ALL_WORKER
+    # number of data-center parties (OUR extension): lets the global
+    # server count FSA rounds exactly when parties run DIFFERENT numbers
+    # of local servers; 0 = infer num_global_workers / party_nsrv
+    # (uniform parties, the reference's implicit assumption)
+    num_parties: int = 0                # DMLC_NUM_PARTY
     is_master_worker: bool = False      # DMLC_ROLE_MASTER_WORKER
     enable_central_worker: bool = True  # DMLC_ENABLE_CENTRAL_WORKER
 
@@ -154,6 +159,7 @@ def load() -> Config:
         num_global_workers=env_int("DMLC_NUM_GLOBAL_WORKER", 0),
         num_global_servers=env_int("DMLC_NUM_GLOBAL_SERVER", 0),
         num_all_workers=env_int("DMLC_NUM_ALL_WORKER", env_int("DMLC_NUM_WORKER", 1)),
+        num_parties=env_int("DMLC_NUM_PARTY", 0),
         is_master_worker=env_bool("DMLC_ROLE_MASTER_WORKER"),
         enable_central_worker=env_bool("DMLC_ENABLE_CENTRAL_WORKER", True),
         interface=env_str("DMLC_INTERFACE"),

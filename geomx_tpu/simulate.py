@@ -58,8 +58,16 @@ class InProcessHiPS:
         self.num_parties = num_parties
         self.wpp = workers_per_party
         self.ngs = num_global_servers
-        self.spp = servers_per_party
-        self.ngw = num_parties * servers_per_party
+        # servers_per_party: an int (uniform) or a per-party list —
+        # non-uniform topologies need cfg.num_parties for exact FSA
+        # counting (set automatically below)
+        if isinstance(servers_per_party, int):
+            self.spp_list = [servers_per_party] * num_parties
+        else:
+            self.spp_list = list(servers_per_party)
+            assert len(self.spp_list) == num_parties
+        self.spp = self.spp_list[0]
+        self.ngw = sum(self.spp_list)
         self.num_all = num_parties * workers_per_party
         self.bigarray_bound = bigarray_bound
         self.use_hfa = use_hfa
@@ -79,6 +87,8 @@ class InProcessHiPS:
         base = dict(
             ps_global_root_uri="127.0.0.1", ps_global_root_port=self.gport,
             num_global_workers=self.ngw, num_global_servers=self.ngs,
+            num_parties=(self.num_parties
+                         if len(set(self.spp_list)) > 1 else 0),
             num_all_workers=self.num_all, use_hfa=self.use_hfa,
             hfa_k2=self.hfa_k2, enable_central_worker=self.ecw,
             bigarray_bound=self.bigarray_bound,
@@ -127,12 +137,13 @@ class InProcessHiPS:
         worker_boxes = []
         for p in range(self.num_parties):
             port = self.cports[p + 1]
-            self._spawn(self._run_sched, port, False, self.wpp, self.spp)
-            for _ in range(self.spp):
+            spp = self.spp_list[p]
+            self._spawn(self._run_sched, port, False, self.wpp, spp)
+            for _ in range(spp):
                 cfg = self._common(
                     role="server",
                     ps_root_uri="127.0.0.1", ps_root_port=port,
-                    num_workers=self.wpp, num_servers=self.spp,
+                    num_workers=self.wpp, num_servers=spp,
                 )
                 srv = KVStoreDistServer(cfg)
                 self.servers.append(srv)
@@ -141,7 +152,7 @@ class InProcessHiPS:
                 wcfg = self._common(
                     role="worker",
                     ps_root_uri="127.0.0.1", ps_root_port=port,
-                    num_workers=self.wpp, num_servers=self.spp,
+                    num_workers=self.wpp, num_servers=spp,
                 )
                 box: list = []
                 worker_boxes.append(box)

@@ -273,3 +273,36 @@ if __name__ == "__main__":
     import sys
 
     sys.exit(pytest.main([__file__, "-x", "-q"]))
+
+
+def test_non_uniform_party_sizes_exact_counting():
+    """Parties running DIFFERENT numbers of local servers: with
+    DMLC_NUM_PARTY set (simulate sets it automatically for non-uniform
+    topologies) the global server counts rounds exactly — the reference's
+    aligned-key counting cannot express this topology at all."""
+    topo = Topology(servers_per_party=[2, 1], bigarray_bound=16).start(
+        sync_global=True)
+    try:
+        topo.master.set_optimizer(SGD(learning_rate=1.0))
+        keys = [0, 1]
+        w0 = {0: np.arange(40, dtype=np.float32),
+              1: np.full(8, 3.0, np.float32)}
+        _parallel([lambda kv=kv: [kv.init(k, w0[k]) for k in keys]
+                   for kv in topo.workers + [topo.master]])
+
+        def train(kv):
+            for r in range(1, 4):
+                for k in keys:
+                    kv.push(k, np.ones_like(w0[k]))
+                outs = {k: np.zeros_like(w0[k]) for k in keys}
+                for k in keys:
+                    kv.pull(k, out=outs[k])
+                kv.wait()
+                for k in keys:
+                    np.testing.assert_allclose(
+                        outs[k], w0[k] - 4.0 * r,
+                        err_msg=f"key {k} round {r}")
+
+        _parallel([lambda kv=kv: train(kv) for kv in topo.workers])
+    finally:
+        topo.stop()
