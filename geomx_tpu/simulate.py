@@ -121,8 +121,29 @@ class InProcessHiPS:
         po.van.stop()
 
     def start(self, sync_global: Optional[bool] = None) -> "InProcessHiPS":
+        """Start the topology; retries with FRESH ports on bind/startup
+        failure — free_port() probes are inherently racy against other
+        processes grabbing the port between probe and bind."""
         if sync_global is not None:
             self.sync_global = sync_global
+        last: Optional[BaseException] = None
+        for attempt in range(3):
+            try:
+                return self._start_once()
+            except (OSError, TimeoutError) as e:
+                last = e
+                # abandon the half-started attempt (daemon threads) and
+                # re-roll every port; a fresh errors list detaches the
+                # old attempt's late failures
+                self.threads = []
+                self.servers = []
+                self.errors = []
+                self.gport = free_port()
+                self.cports = [free_port()
+                               for _ in range(self.num_parties + 1)]
+        raise last
+
+    def _start_once(self) -> "InProcessHiPS":
         self._spawn(self._run_sched, self.gport, True, self.ngw, self.ngs)
         self._spawn(self._run_sched, self.cports[0], False, 1, self.ngs)
         for _ in range(self.ngs):
