@@ -174,6 +174,8 @@ def bench_hips():
         # step objects (jit is thread-safe; one compile instead of two —
         # tunnel compiles are expensive)
         leaves0, _td, grad_step, eval_step = build_model_and_step(bs)
+        from examples.utils import build_flat_step
+        flat_step, pack, unpack = build_flat_step(leaves0, grad_step)
 
         import jax
 
@@ -195,12 +197,14 @@ def bench_hips():
                        for X, y in list(train_iter)[:8]]
 
             def one_round(X, y):
-                # ONE batched host->device transfer for params and ONE
+                # ONE fused host->device transfer for params and ONE
                 # device->host for grads (this environment's chip hangs
-                # off a network tunnel, so each individual transfer costs
-                # ~ms; per-key transfers cost 10x the PS protocol itself)
-                _loss, grads = grad_step(jax.device_put(leaves), X, y)
-                grads = jax.device_get(grads)
+                # off a network tunnel, so each transfer costs ~13 ms of
+                # link RTT; per-leaf transfers cost 8 RTTs per round —
+                # see build_flat_step)
+                _loss, gflat = flat_step(jax.device_put(pack(leaves)),
+                                         X, y)
+                grads = unpack(jax.device_get(gflat))
                 for idx, g in enumerate(grads):
                     kv.push(idx, g, priority=-idx)
                     kv.pull(idx, out=leaves[idx], priority=-idx)
@@ -279,6 +283,8 @@ def bench_hips_hfa(hfa_k1: int = 4, hfa_k2: int = 2):
     try:
         bs = BATCH_PER_WORKER
         leaves0, _td, grad_step, _eval_step = build_model_and_step(bs)
+        from examples.utils import build_flat_step
+        flat_step, pack, unpack = build_flat_step(leaves0, grad_step)
         iters = [0, 0]
         stop_round = [None]
         started = threading.Event()
@@ -303,8 +309,9 @@ def bench_hips_hfa(hfa_k1: int = 4, hfa_k2: int = 2):
             i = 0
             while stop_round[0] is None or iters[widx] < stop_round[0]:
                 X, y = batches[i % len(batches)]
-                _loss, grads = grad_step(jax.device_put(leaves), X, y)
-                grads = jax.device_get(grads)
+                _loss, gflat = flat_step(jax.device_put(pack(leaves)),
+                                         X, y)
+                grads = unpack(jax.device_get(gflat))
                 for idx, g in enumerate(grads):
                     leaves[idx] = np.asarray(opt.update(
                         idx, leaves[idx], g)).reshape(leaves[idx].shape)
@@ -339,17 +346,21 @@ def bench_hips_hfa(hfa_k1: int = 4, hfa_k2: int = 2):
         topo.stop()
 
 
-def bench_transformer_mfu():
-    """Single-chip transformer train step -> MFU."""
+def bench_transformer_mfu(attn_impl: str = "dense"):
+    """Single-chip transformer train step -> MFU.
+
+    ``attn_impl``: "dense" (XLA einsum) or "flash" (the Pallas
+    FlashAttention-2 kernels in geomx_tpu.ops.flash_attention)."""
     import jax
     import jax.numpy as jnp
     import optax
 
-    from geomx_tpu.models.transformer import Transformer
+    from geomx_tpu.models.transformer import Transformer, make_attention
 
     B, T, D, L, H = 16, 512, 512, 8, 8
+    attn_fn = make_attention(attn_impl) if attn_impl != "dense" else None
     model = Transformer(vocab=32768, dim=D, depth=L, heads=H, max_len=T,
-                        compute_dtype=jnp.bfloat16)
+                        attn_fn=attn_fn, compute_dtype=jnp.bfloat16)
     rng = jax.random.PRNGKey(0)
     tokens = jax.random.randint(rng, (B, T), 0, 32768)
     params = model.init(rng, tokens[:1])
@@ -392,6 +403,7 @@ def bench_transformer_mfu():
         "tokens_per_s": round(steps_s * B * T, 0),
         "tflops_s": round(flops_s / 1e12, 2),
         "mfu": round(flops_s / peak, 4) if peak else None,
+        "attn": attn_impl,
         "device": __import__("jax").devices()[0].device_kind,
     }
 
@@ -434,12 +446,21 @@ def main():
                                    "trials": hfa["trials"]}
     except Exception as e:  # noqa: BLE001 — secondary metric
         details["hips_hfa_cnn"] = {"error": str(e)}
+    import jax
+
+    # fixed keys so the schema is stable run-to-run: "transformer" is
+    # ALWAYS the dense-attention result; the Pallas flash path (chip
+    # only — interpret mode on CPU is test-grade, not perf-grade) is
+    # always "transformer_flash"
     try:
-        details["transformer"] = bench_transformer_mfu()
+        details["transformer"] = bench_transformer_mfu("dense")
     except Exception as e:  # noqa: BLE001 — secondary metric
         details["transformer"] = {"error": str(e)}
-
-    import jax
+    if jax.default_backend() == "tpu":
+        try:
+            details["transformer_flash"] = bench_transformer_mfu("flash")
+        except Exception as e:  # noqa: BLE001 — secondary metric
+            details["transformer_flash"] = {"error": str(e)}
 
     if jax.default_backend() != "cpu":
         # context for the judge: in this harness the chip is reached via

@@ -52,7 +52,7 @@ def main():
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from geomx_tpu.models.transformer import (
-        Transformer, transformer_param_sharding)
+        Transformer, make_attention, transformer_param_sharding)
     from geomx_tpu.parallel.mesh import make_mesh
     from geomx_tpu.parallel.ring_attention import make_ring_attention
 
@@ -61,7 +61,11 @@ def main():
     print(f"mesh: dp={dp} tp={args.tp} sp={args.sp} "
           f"({len(jax.devices())} x {jax.devices()[0].device_kind})")
 
-    attn = make_ring_attention(mesh, causal=True) if args.sp > 1 else None
+    # sp>1: ring attention (sequence sharded over the mesh); otherwise the
+    # per-device pick — Pallas flash kernels on TPU (shard_mapped over
+    # dp/tp when the mesh is multi-device), XLA dense elsewhere
+    attn = (make_ring_attention(mesh, causal=True) if args.sp > 1
+            else make_attention("auto", mesh=mesh))
     model = Transformer(vocab=args.vocab, dim=args.dim, depth=args.depth,
                         heads=args.heads, max_len=args.seq_len,
                         attn_fn=attn, compute_dtype=jnp.bfloat16)

@@ -17,6 +17,49 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def make_attention(impl: str = "auto", *, causal: bool = True,
+                   mesh: Optional[Mesh] = None,
+                   block_q: int = 128, block_k: int = 128) -> Callable:
+    """Attention implementation selector for ``Transformer(attn_fn=...)``.
+
+    ``"flash"`` — the Pallas FlashAttention-2 kernels
+    (geomx_tpu.ops.flash_attention): O(block^2) on-chip memory,
+    MXU-tiled, the choice for long sequences on TPU. ``"dense"`` — the
+    XLA einsum reference. ``"auto"`` picks flash on TPU backends and
+    dense elsewhere (on CPU the Pallas kernels run interpreted, which
+    is test-grade, not perf-grade).
+
+    A Pallas kernel has no SPMD partitioning rule, so on a multi-device
+    ``mesh`` the flash path must run under shard_map; attention is
+    independent per batch ("dp") and head ("tp"), so pass the mesh and
+    the kernel runs per-shard. (Sequence-sharded meshes need ring
+    attention — ``parallel.make_ring_attention`` — not this hook.)
+    """
+    if impl == "auto":
+        impl = "flash" if jax.default_backend() == "tpu" else "dense"
+    if impl == "flash":
+        from geomx_tpu.ops.flash_attention import flash_attention
+
+        fn = lambda q, k, v: flash_attention(  # noqa: E731
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k)
+        if mesh is not None and mesh.devices.size > 1:
+            if "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
+                raise ValueError(
+                    "flash attention cannot shard the sequence axis; "
+                    "use parallel.make_ring_attention for sp > 1")
+            spec = P(("dp",) if "dp" in mesh.axis_names else None, None,
+                     "tp" if "tp" in mesh.axis_names else None, None)
+            # check_vma=False: pallas_call outputs carry no varying-mesh-
+            # axes annotation, and the kernel touches no collectives
+            return jax.shard_map(fn, mesh=mesh,
+                                 in_specs=(spec, spec, spec),
+                                 out_specs=spec, check_vma=False)
+        return fn
+    if impl == "dense":
+        return lambda q, k, v: dense_attention(q, k, v, causal=causal)
+    raise ValueError(f"unknown attention impl {impl!r}")
+
+
 def dense_attention(q, k, v, *, causal: bool = True):
     """Plain attention fallback (single-device / no sp axis)."""
     d = q.shape[-1]

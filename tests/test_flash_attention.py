@@ -1,0 +1,162 @@
+"""FlashAttention Pallas kernels vs the dense reference.
+
+Runs in Pallas interpret mode on the CPU mesh (conftest pins
+JAX_PLATFORMS=cpu); the same code path compiles for TPU. Checks
+forward values and all three gradients against
+``models.transformer.dense_attention`` (reference for the math:
+FlashAttention-2; the GeoMX reference has no attention op, SURVEY §5.7).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from geomx_tpu.models.transformer import dense_attention
+from geomx_tpu.ops.flash_attention import flash_attention
+
+
+def _rand(shape, key, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, dtype=dtype)
+
+
+def _check(B, T, H, D, causal, block=32, dtype=jnp.float32,
+           tol=2e-5):
+    q = _rand((B, T, H, D), 0, dtype)
+    k = _rand((B, T, H, D), 1, dtype)
+    v = _rand((B, T, H, D), 2, dtype)
+
+    def f_flash(q, k, v):
+        return flash_attention(q, k, v, causal=causal, block_q=block,
+                               block_k=block)
+
+    def f_dense(q, k, v):
+        return dense_attention(q, k, v, causal=causal)
+
+    out_f = f_flash(q, k, v)
+    out_d = f_dense(q, k, v)
+    np.testing.assert_allclose(np.asarray(out_f, np.float32),
+                               np.asarray(out_d, np.float32),
+                               atol=tol, rtol=tol)
+
+    cot = _rand(out_d.shape, 3, out_d.dtype)
+    gf = jax.vjp(f_flash, q, k, v)[1](cot)
+    gd = jax.vjp(f_dense, q, k, v)[1](cot)
+    for name, a, b in zip("qkv", gf, gd):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=10 * tol, rtol=10 * tol,
+            err_msg=f"d{name} mismatch (causal={causal}, T={T})")
+
+
+def test_forward_backward_causal():
+    _check(B=2, T=64, H=2, D=16, causal=True)
+
+
+def test_forward_backward_full():
+    _check(B=2, T=64, H=2, D=16, causal=False)
+
+
+def test_ragged_seq_len_pads_correctly():
+    # T=50 is not a multiple of the 32-block: exercises padding+masking
+    _check(B=1, T=50, H=2, D=8, causal=True)
+    _check(B=1, T=50, H=2, D=8, causal=False)
+
+
+def test_multi_block_causal_boundary():
+    # several k-blocks per q-block, exercising the causal skip logic
+    _check(B=1, T=96, H=1, D=8, causal=True, block=16)
+
+
+def test_bfloat16_inputs():
+    _check(B=1, T=32, H=2, D=16, causal=True, dtype=jnp.bfloat16,
+           tol=2e-2)
+
+
+def test_jit_and_grad_compose():
+    q = _rand((1, 32, 2, 8), 0)
+    k = _rand((1, 32, 2, 8), 1)
+    v = _rand((1, 32, 2, 8), 2)
+
+    @jax.jit
+    def loss(q, k, v):
+        return flash_attention(q, k, v, block_q=16, block_k=16).sum()
+
+    g = jax.grad(loss)(q, k, v)
+    assert g.shape == q.shape and bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_matches_transformer_plug_in():
+    """flash_attention slots into the Transformer attn_fn hook."""
+    from geomx_tpu.models.transformer import Transformer
+
+    tok = jax.random.randint(jax.random.PRNGKey(0), (2, 24), 0, 64)
+    m_dense = Transformer(vocab=64, dim=32, depth=1, heads=2, max_len=64)
+    m_flash = Transformer(vocab=64, dim=32, depth=1, heads=2, max_len=64,
+                          attn_fn=lambda q, k, v: flash_attention(
+                              q, k, v, block_q=8, block_k=8))
+    p = m_dense.init(jax.random.PRNGKey(1), tok)
+    np.testing.assert_allclose(
+        np.asarray(m_flash.apply(p, tok)),
+        np.asarray(m_dense.apply(p, tok)), atol=1e-4, rtol=1e-4)
+
+
+def test_shard_mapped_flash_on_mesh():
+    """make_attention(mesh=...) runs the kernel per dp/tp shard (the
+    Pallas call has no SPMD rule; shard_map supplies the partitioning)."""
+    from geomx_tpu.models.transformer import make_attention
+    from geomx_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(jax.devices(), tp=2, sp=1)  # dp=4 x tp=2 on 8 cpus
+    attn = make_attention("flash", mesh=mesh, block_q=8, block_k=8)
+    q = _rand((4, 16, 2, 8), 0)
+    k = _rand((4, 16, 2, 8), 1)
+    v = _rand((4, 16, 2, 8), 2)
+    out = jax.jit(attn)(q, k, v)
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_make_attention_rejects_sp_sharding():
+    from geomx_tpu.models.transformer import make_attention
+    from geomx_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(jax.devices(), tp=1, sp=2)
+    with pytest.raises(ValueError, match="ring"):
+        make_attention("flash", mesh=mesh)
+
+
+def test_cross_attention_unequal_lengths():
+    """Tq != Tk, non-causal (cross-attention)."""
+    q = _rand((1, 24, 2, 8), 0)
+    k = _rand((1, 40, 2, 8), 1)
+    v = _rand((1, 40, 2, 8), 2)
+    out = flash_attention(q, k, v, causal=False, block_q=8, block_k=8)
+    # dense reference built by hand (dense_attention assumes Tq == Tk)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(8.0)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_causal_decode_offset():
+    """Causal with Tq < Tk: queries are the LAST Tq positions of the key
+    sequence (kv-cache decode convention) — a single query must attend
+    to the whole prefix, not just key 0."""
+    Tq, Tk = 8, 32
+    q = _rand((1, Tq, 1, 8), 0)
+    k = _rand((1, Tk, 1, 8), 1)
+    v = _rand((1, Tk, 1, 8), 2)
+    out = flash_attention(q, k, v, causal=True, block_q=8, block_k=8)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(8.0)
+    qpos = jnp.arange(Tq)[:, None] + (Tk - Tq)
+    mask = qpos >= jnp.arange(Tk)[None, :]
+    s = jnp.where(mask[None, None], s, -1e30)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    # and the gradient path composes for the decode shape
+    g = jax.grad(lambda q: flash_attention(
+        q, k, v, causal=True, block_q=8, block_k=8).sum())(q)
+    assert bool(jnp.all(jnp.isfinite(g)))
