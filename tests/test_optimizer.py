@@ -1,0 +1,268 @@
+"""Optimizer family + LR schedulers.
+
+Covers the reference optimizer library surface
+(python/mxnet/optimizer/optimizer.py) and lr_scheduler.py: update-rule
+math spot-checks, convergence on a convex problem for every registry
+entry, pickling (the command-channel transport requirement), and the
+scheduler/num_update contract.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from geomx_tpu import lr_scheduler as lrs
+from geomx_tpu import optimizer as opt_mod
+from geomx_tpu.optimizer import (
+    SGD, NAG, Signum, SGLD, Adam, Adamax, Nadam, FTML, AdaGrad, RMSProp,
+    AdaDelta, Ftrl, DCASGD, create,
+)
+
+
+ALL_NAMES = sorted(opt_mod._REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# convergence: every optimizer shrinks ||w|| on grad = w (quadratic bowl)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", ALL_NAMES)
+def test_converges_on_quadratic(name):
+    # per-family pacing: adagrad's effective lr decays 1/sqrt(t),
+    # adadelta self-scales from eps, ftrl is proximal, sgld is a
+    # SAMPLER (stationary std ~ 1, so only the mean contracts)
+    kw, iters, bound = {"learning_rate": 0.05}, 400, 0.5
+    if name in ("adadelta", "adagrad", "ftrl"):
+        kw, iters, bound = {"learning_rate": 0.5}, 2000, 0.05
+    elif name == "sgld":
+        kw, iters, bound = {"learning_rate": 0.002, "seed": 3}, 2000, 1.5
+    opt = create(name, **kw)
+    w = np.full(64, 5.0, np.float32)
+    for _ in range(iters):
+        w = np.asarray(opt.update(0, w, w.copy()), np.float32)
+    end = float(np.mean(np.abs(w)))
+    assert end < bound, f"{name}: mean|w| only reached {end} from 5.0"
+
+
+# ---------------------------------------------------------------------------
+# update-rule math (one or two steps, hand-computed)
+# ---------------------------------------------------------------------------
+
+def test_nag_matches_reference_formula():
+    opt = NAG(learning_rate=0.1, momentum=0.9)
+    w = np.array([1.0], np.float32)
+    g = np.array([0.5], np.float32)
+    # step 1: state = g; w -= lr*(g + mom*state)
+    w1 = opt.update(0, w, g)
+    np.testing.assert_allclose(w1, 1.0 - 0.1 * (0.5 + 0.9 * 0.5))
+    # step 2 with g2: state = mom*state + g2; w -= lr*(g2 + mom*state)
+    g2 = np.array([0.2], np.float32)
+    state = 0.9 * 0.5 + 0.2
+    w2 = opt.update(0, w1, g2)
+    np.testing.assert_allclose(
+        w2, np.asarray(w1) - 0.1 * (0.2 + 0.9 * state), rtol=1e-6)
+
+
+def test_signum_takes_sign_and_decoupled_wd():
+    opt = Signum(learning_rate=0.1, momentum=0.0, wd_lh=0.1)
+    w = np.array([2.0, -2.0], np.float32)
+    g = np.array([0.003, -7.0], np.float32)
+    out = opt.update(0, w, g)
+    np.testing.assert_allclose(
+        out, (1 - 0.1 * 0.1) * w - 0.1 * np.array([1.0, -1.0]), rtol=1e-6)
+
+
+def test_adagrad_accumulates_history():
+    opt = AdaGrad(learning_rate=0.5, eps=1e-7)
+    w = np.array([1.0], np.float32)
+    g = np.array([2.0], np.float32)
+    w1 = opt.update(0, w, g)
+    np.testing.assert_allclose(w1, 1.0 - 0.5 * 2.0 / np.sqrt(4 + 1e-7),
+                               rtol=1e-6)
+    w2 = opt.update(0, w1, g)
+    np.testing.assert_allclose(
+        w2, np.asarray(w1) - 0.5 * 2.0 / np.sqrt(8 + 1e-7), rtol=1e-6)
+
+
+def test_rmsprop_plain_and_centered():
+    g = np.array([1.0], np.float32)
+    w = np.array([1.0], np.float32)
+    plain = RMSProp(learning_rate=0.1, gamma1=0.9, epsilon=1e-8)
+    w1 = plain.update(0, w, g)
+    n = 0.1 * 1.0
+    np.testing.assert_allclose(w1, 1.0 - 0.1 * 1.0 / np.sqrt(n + 1e-8),
+                               rtol=1e-6)
+    cent = RMSProp(learning_rate=0.1, gamma1=0.9, gamma2=0.9,
+                   centered=True, epsilon=1e-8)
+    w1c = cent.update(0, w, g)
+    gbar = 0.1 * 1.0
+    delta = -0.1 * 1.0 / np.sqrt(n - gbar ** 2 + 1e-8)
+    np.testing.assert_allclose(w1c, 1.0 + delta, rtol=1e-6)
+
+
+def test_adadelta_reference_formula():
+    opt = AdaDelta(rho=0.9, epsilon=1e-5)
+    w = np.array([1.0], np.float32)
+    g = np.array([2.0], np.float32)
+    out = opt.update(0, w, g)
+    acc_g = 0.1 * 4.0
+    delta = np.sqrt(1e-5) / np.sqrt(acc_g + 1e-5) * 2.0
+    np.testing.assert_allclose(out, 1.0 - delta, rtol=1e-5)
+
+
+def test_ftrl_sparsifies_small_weights():
+    """|z| <= lamda1 coordinates snap to exactly zero (the FTRL
+    proximal property the reference update encodes)."""
+    opt = Ftrl(lamda1=1.0, learning_rate=0.1, beta=1.0)
+    w = np.zeros(2, np.float32)
+    out = opt.update(0, w, np.array([0.01, 50.0], np.float32))
+    assert out[0] == 0.0 and out[1] != 0.0
+
+
+def test_adamax_infinity_norm():
+    opt = Adamax(learning_rate=0.002, beta1=0.9, beta2=0.999)
+    w = np.array([1.0], np.float32)
+    g = np.array([4.0], np.float32)
+    out = opt.update(0, w, g)
+    m = 0.1 * 4.0
+    u = 4.0  # max(0.999*0, |g|)
+    np.testing.assert_allclose(
+        out, 1.0 - 0.002 / (1 - 0.9) * m / u, rtol=1e-6)
+
+
+def test_nadam_first_step():
+    opt = Nadam(learning_rate=0.1, beta1=0.9, beta2=0.999, epsilon=1e-8)
+    w = np.array([1.0], np.float32)
+    g = np.array([1.0], np.float32)
+    out = opt.update(0, w, g)
+    mt = 0.9 * (1 - 0.5 * 0.96 ** 0.004)
+    mt1 = 0.9 * (1 - 0.5 * 0.96 ** 0.008)
+    msched = mt
+    gp = 1.0 / (1 - msched)
+    mp = (0.1 * 1.0) / (1 - msched * mt1)
+    vp = (0.001 * 1.0) / (1 - 0.999)
+    mbar = (1 - mt) * gp + mt1 * mp
+    np.testing.assert_allclose(
+        out, 1.0 - 0.1 * mbar / (np.sqrt(vp) + 1e-8), rtol=1e-5)
+
+
+def test_ftml_first_step():
+    opt = FTML(learning_rate=0.1, beta1=0.6, beta2=0.999, epsilon=1e-8)
+    w = np.array([1.0], np.float32)
+    g = np.array([2.0], np.float32)
+    out = opt.update(0, w, g)
+    v = 0.001 * 4.0
+    d_t = (1 - 0.6) / 0.1 * (np.sqrt(v / 0.001) + 1e-8)
+    z = 0.4 * 2.0 - d_t * 1.0
+    np.testing.assert_allclose(out, -z / d_t, rtol=1e-5)
+
+
+def test_sgld_adds_noise_with_lr_scale():
+    a = SGLD(learning_rate=0.01, seed=7)
+    b = SGLD(learning_rate=0.01, seed=7)
+    w = np.zeros(1000, np.float32)
+    g = np.zeros(1000, np.float32)
+    oa, ob = a.update(0, w, g), b.update(0, w, g)
+    np.testing.assert_array_equal(oa, ob)  # seeded determinism
+    assert 0.05 < float(np.std(oa)) < 0.2  # ~ sqrt(lr) = 0.1
+
+
+# ---------------------------------------------------------------------------
+# pickling (command-channel transport) and state round-trip
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", ALL_NAMES)
+def test_pickle_round_trip_continues_identically(name):
+    opt = create(name, learning_rate=0.05)
+    w = np.full(4, 3.0, np.float32)
+    rng = np.random.default_rng(0)
+    for _ in range(5):
+        w = np.asarray(opt.update(0, w, rng.normal(
+            size=4).astype(np.float32)))
+    clone = pickle.loads(pickle.dumps(opt))
+    g = np.ones(4, np.float32)
+    np.testing.assert_allclose(np.asarray(opt.update(0, w.copy(), g)),
+                               np.asarray(clone.update(0, w.copy(), g)),
+                               rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# LR schedulers
+# ---------------------------------------------------------------------------
+
+def test_factor_scheduler_decay_and_floor():
+    s = lrs.FactorScheduler(step=10, factor=0.1, base_lr=1.0,
+                            stop_factor_lr=1e-3)
+    assert s(1) == 1.0
+    assert abs(s(11) - 0.1) < 1e-12
+    assert abs(s(21) - 0.01) < 1e-12
+    for nu in (31, 41, 51):
+        s(nu)
+    assert s(99) == 1e-3  # floored
+
+
+def test_multifactor_milestones():
+    s = lrs.MultiFactorScheduler(step=[5, 8], factor=0.5, base_lr=1.0)
+    assert s(5) == 1.0
+    assert s(6) == 0.5
+    assert s(8) == 0.5
+    assert s(9) == 0.25
+    assert s(100) == 0.25
+
+
+def test_poly_and_cosine_endpoints():
+    p = lrs.PolyScheduler(max_update=100, base_lr=1.0, pwr=2,
+                          final_lr=0.1)
+    assert abs(p(0) - 1.0) < 1e-12
+    assert abs(p(100) - 0.1) < 1e-12
+    c = lrs.CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.0)
+    assert abs(c(0) - 1.0) < 1e-12
+    assert abs(c(50) - 0.5) < 1e-9
+    assert abs(c(100) - 0.0) < 1e-12
+
+
+def test_warmup_linear_then_decay():
+    s = lrs.CosineScheduler(max_update=20, base_lr=1.0,
+                            warmup_steps=10, warmup_begin_lr=0.0)
+    assert s(0) == 0.0
+    assert abs(s(5) - 0.5) < 1e-12
+    assert abs(s(10) - 1.0) < 1e-12  # decay starts at base_lr
+
+
+def test_scheduler_factory_and_validation():
+    assert isinstance(lrs.create("cosine", max_update=10),
+                      lrs.CosineScheduler)
+    with pytest.raises(ValueError):
+        lrs.create("nope")
+    with pytest.raises(ValueError):
+        lrs.FactorScheduler(step=0)
+    with pytest.raises(ValueError):
+        lrs.MultiFactorScheduler(step=[5, 3])
+
+
+def test_optimizer_uses_scheduler_with_max_key_count():
+    """num_update is the MAX per-key count (reference lr_scheduler
+    contract) and the effective lr follows the scheduler."""
+    sched = lrs.MultiFactorScheduler(step=[2], factor=0.1, base_lr=0.5)
+    opt = SGD(learning_rate=0.5, lr_scheduler=sched)
+    w = np.zeros(1, np.float32)
+    g = np.ones(1, np.float32)
+    # key 0 updated 3x -> num_update 3 > milestone 2 -> lr 0.05
+    opt.update(0, w, g)
+    opt.update(0, w, g)
+    opt.update(0, w, g)
+    out = opt.update(1, w.copy(), g)  # key 1 first update, lr already 0.05
+    np.testing.assert_allclose(out, -0.05, rtol=1e-6)
+
+
+def test_scheduler_travels_in_pickle():
+    sched = lrs.FactorScheduler(step=1, factor=0.5, base_lr=1.0)
+    opt = SGD(learning_rate=1.0, lr_scheduler=sched)
+    w, g = np.zeros(1, np.float32), np.ones(1, np.float32)
+    for _ in range(3):
+        opt.update(0, w, g)
+    clone = pickle.loads(pickle.dumps(opt))
+    np.testing.assert_allclose(
+        np.asarray(opt.update(0, w.copy(), g)),
+        np.asarray(clone.update(0, w.copy(), g)))
