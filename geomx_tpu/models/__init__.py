@@ -6,3 +6,6 @@ CNN, examples/cnn.py:56-63).
 from geomx_tpu.models.cnn import LeNetCNN, create_cnn  # noqa: F401
 from geomx_tpu.models.mlp import MLP  # noqa: F401
 from geomx_tpu.models.resnet import ResNet, create_resnet  # noqa: F401
+from geomx_tpu.models.zoo import (  # noqa: F401
+    AlexNet, DenseNet, InceptionV3, MobileNetV1, MobileNetV2, SqueezeNet,
+    VGG, get_model)

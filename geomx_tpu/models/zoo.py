@@ -1,0 +1,407 @@
+"""Vision model zoo, TPU-idiomatic flax (NHWC, bf16-friendly).
+
+Parity with the reference's gluon vision zoo
+(reference: python/mxnet/gluon/model_zoo/vision/ — alexnet.py, vgg.py,
+squeezenet.py, mobilenet.py, densenet.py, inception.py) re-designed as
+flax modules rather than HybridBlock translations: NHWC layout (TPU
+conv layout), ``compute_dtype`` for bf16 activations with f32 params,
+BatchNorm via flax ``batch_stats`` collections.
+
+``get_model(name)`` mirrors ``model_zoo.vision.get_model``
+(reference: vision/__init__.py:91-161), including the resnet names
+(served by ``geomx_tpu.models.resnet``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _same(k: int) -> list:
+    p = k // 2
+    return [(p, p), (p, p)]
+
+
+class AlexNet(nn.Module):
+    """reference: vision/alexnet.py:36-77."""
+
+    num_classes: int = 1000
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dt = self.compute_dtype
+        x = x.astype(dt)
+        x = nn.relu(nn.Conv(64, (11, 11), (4, 4), padding=[(2, 2), (2, 2)],
+                            dtype=dt)(x))
+        x = nn.max_pool(x, (3, 3), (2, 2))
+        x = nn.relu(nn.Conv(192, (5, 5), padding=_same(5), dtype=dt)(x))
+        x = nn.max_pool(x, (3, 3), (2, 2))
+        x = nn.relu(nn.Conv(384, (3, 3), padding=_same(3), dtype=dt)(x))
+        x = nn.relu(nn.Conv(256, (3, 3), padding=_same(3), dtype=dt)(x))
+        x = nn.relu(nn.Conv(256, (3, 3), padding=_same(3), dtype=dt)(x))
+        x = nn.max_pool(x, (3, 3), (2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(4096, dtype=dt)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=dt)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=dt)(x).astype(jnp.float32)
+
+
+class VGG(nn.Module):
+    """reference: vision/vgg.py:33-104 (layers/filters specs at :105)."""
+
+    layers: Sequence[int]
+    filters: Sequence[int]
+    batch_norm: bool = False
+    num_classes: int = 1000
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dt = self.compute_dtype
+        x = x.astype(dt)
+        for n, f in zip(self.layers, self.filters):
+            for _ in range(n):
+                x = nn.Conv(f, (3, 3), padding=_same(3), dtype=dt)(x)
+                if self.batch_norm:
+                    x = nn.BatchNorm(use_running_average=not train,
+                                     dtype=dt)(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), (2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(4096, dtype=dt)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=dt)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=dt)(x).astype(jnp.float32)
+
+
+_VGG_SPEC = {  # reference: vgg.py:105-109
+    11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
+    13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
+    16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
+    19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512]),
+}
+
+
+class SqueezeNet(nn.Module):
+    """reference: vision/squeezenet.py:48-120 (fire module at :36)."""
+
+    version: str = "1.0"
+    num_classes: int = 1000
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dt = self.compute_dtype
+
+        def fire(x, squeeze, expand):
+            s = nn.relu(nn.Conv(squeeze, (1, 1), dtype=dt)(x))
+            e1 = nn.relu(nn.Conv(expand, (1, 1), dtype=dt)(s))
+            e3 = nn.relu(nn.Conv(expand, (3, 3), padding=_same(3),
+                                 dtype=dt)(s))
+            return jnp.concatenate([e1, e3], axis=-1)
+
+        x = x.astype(dt)
+        if self.version == "1.0":
+            x = nn.relu(nn.Conv(96, (7, 7), (2, 2), dtype=dt)(x))
+            x = nn.max_pool(x, (3, 3), (2, 2))
+            for sq in (16, 16, 32):
+                x = fire(x, sq, sq * 4)
+            x = nn.max_pool(x, (3, 3), (2, 2))
+            for sq in (32, 48, 48, 64):
+                x = fire(x, sq, sq * 4)
+            x = nn.max_pool(x, (3, 3), (2, 2))
+            x = fire(x, 64, 256)
+        else:  # 1.1
+            x = nn.relu(nn.Conv(64, (3, 3), (2, 2), dtype=dt)(x))
+            x = nn.max_pool(x, (3, 3), (2, 2))
+            x = fire(x, 16, 64)
+            x = fire(x, 16, 64)
+            x = nn.max_pool(x, (3, 3), (2, 2))
+            x = fire(x, 32, 128)
+            x = fire(x, 32, 128)
+            x = nn.max_pool(x, (3, 3), (2, 2))
+            for sq in (48, 48, 64, 64):
+                x = fire(x, sq, sq * 4)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Conv(self.num_classes, (1, 1), dtype=dt)(x))
+        return jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
+
+
+class MobileNetV1(nn.Module):
+    """reference: vision/mobilenet.py:131-178 (depthwise-separable at
+    :42-63); ``multiplier`` scales every width."""
+
+    multiplier: float = 1.0
+    num_classes: int = 1000
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dt = self.compute_dtype
+
+        def bn_relu(x):
+            x = nn.BatchNorm(use_running_average=not train, dtype=dt)(x)
+            return nn.relu(x)
+
+        def dw_sep(x, ch, stride):
+            cin = x.shape[-1]
+            x = nn.Conv(cin, (3, 3), (stride, stride), padding=_same(3),
+                        feature_group_count=cin, use_bias=False,
+                        dtype=dt)(x)
+            x = bn_relu(x)
+            x = nn.Conv(ch, (1, 1), use_bias=False, dtype=dt)(x)
+            return bn_relu(x)
+
+        m = self.multiplier
+        x = x.astype(dt)
+        x = bn_relu(nn.Conv(int(32 * m), (3, 3), (2, 2),
+                            padding=_same(3), use_bias=False, dtype=dt)(x))
+        spec = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1),
+                (512, 2)] + [(512, 1)] * 5 + [(1024, 2), (1024, 1)]
+        for ch, s in spec:
+            x = dw_sep(x, max(int(ch * m), 8), s)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes,
+                        dtype=dt)(x).astype(jnp.float32)
+
+
+class MobileNetV2(nn.Module):
+    """reference: vision/mobilenet.py:180-250 (inverted residual
+    "LinearBottleneck" at :66-110)."""
+
+    multiplier: float = 1.0
+    num_classes: int = 1000
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dt = self.compute_dtype
+
+        def bn(x):
+            return nn.BatchNorm(use_running_average=not train, dtype=dt)(x)
+
+        def bottleneck(x, ch, t, stride):
+            cin = x.shape[-1]
+            y = x
+            if t != 1:
+                y = nn.relu6(bn(nn.Conv(cin * t, (1, 1), use_bias=False,
+                                        dtype=dt)(y)))
+            y = nn.Conv(y.shape[-1], (3, 3), (stride, stride),
+                        padding=_same(3), feature_group_count=y.shape[-1],
+                        use_bias=False, dtype=dt)(y)
+            y = nn.relu6(bn(y))
+            y = bn(nn.Conv(ch, (1, 1), use_bias=False, dtype=dt)(y))
+            if stride == 1 and cin == ch:
+                y = y + x
+            return y
+
+        m = self.multiplier
+        x = x.astype(dt)
+        x = nn.relu6(bn(nn.Conv(int(32 * m), (3, 3), (2, 2),
+                                padding=_same(3), use_bias=False,
+                                dtype=dt)(x)))
+        # (expansion t, channels, repeats, first stride) — mobilenet.py:203
+        for t, c, n, s in [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2),
+                           (6, 64, 4, 2), (6, 96, 3, 1), (6, 160, 3, 2),
+                           (6, 320, 1, 1)]:
+            for i in range(n):
+                x = bottleneck(x, max(int(c * m), 8), t, s if i == 0 else 1)
+        last = int(1280 * m) if m > 1.0 else 1280
+        x = nn.relu6(bn(nn.Conv(last, (1, 1), use_bias=False, dtype=dt)(x)))
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes,
+                        dtype=dt)(x).astype(jnp.float32)
+
+
+class DenseNet(nn.Module):
+    """reference: vision/densenet.py:35-119 (dense/transition blocks)."""
+
+    num_init_features: int
+    growth_rate: int
+    block_config: Sequence[int]
+    num_classes: int = 1000
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dt = self.compute_dtype
+
+        def bn_relu(x):
+            x = nn.BatchNorm(use_running_average=not train, dtype=dt)(x)
+            return nn.relu(x)
+
+        def dense_layer(x):
+            y = bn_relu(x)
+            y = nn.Conv(4 * self.growth_rate, (1, 1), use_bias=False,
+                        dtype=dt)(y)
+            y = bn_relu(y)
+            y = nn.Conv(self.growth_rate, (3, 3), padding=_same(3),
+                        use_bias=False, dtype=dt)(y)
+            return jnp.concatenate([x, y], axis=-1)
+
+        x = x.astype(dt)
+        x = nn.Conv(self.num_init_features, (7, 7), (2, 2),
+                    padding=_same(7), use_bias=False, dtype=dt)(x)
+        x = bn_relu(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding=_same(3))
+        for bi, n_layers in enumerate(self.block_config):
+            for _ in range(n_layers):
+                x = dense_layer(x)
+            if bi != len(self.block_config) - 1:  # transition
+                x = bn_relu(x)
+                x = nn.Conv(x.shape[-1] // 2, (1, 1), use_bias=False,
+                            dtype=dt)(x)
+                x = nn.avg_pool(x, (2, 2), (2, 2))
+        x = bn_relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes,
+                        dtype=dt)(x).astype(jnp.float32)
+
+
+_DENSENET_SPEC = {  # reference: densenet.py:24-28
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+}
+
+
+class InceptionV3(nn.Module):
+    """reference: vision/inception.py:30-208. Canonical input 299x299
+    (any >= 75x75 works)."""
+
+    num_classes: int = 1000
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dt = self.compute_dtype
+
+        def conv(x, ch, kernel, strides=(1, 1), padding="VALID"):
+            x = nn.Conv(ch, kernel, strides, padding=padding,
+                        use_bias=False, dtype=dt)(x)
+            x = nn.BatchNorm(use_running_average=not train, dtype=dt)(x)
+            return nn.relu(x)
+
+        def block_a(x, pool_features):
+            b1 = conv(x, 64, (1, 1))
+            b2 = conv(conv(x, 48, (1, 1)), 64, (5, 5), padding=_same(5))
+            b3 = conv(conv(conv(x, 64, (1, 1)), 96, (3, 3),
+                           padding=_same(3)), 96, (3, 3), padding=_same(3))
+            b4 = conv(nn.avg_pool(x, (3, 3), (1, 1), padding=_same(3)),
+                      pool_features, (1, 1))
+            return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+        def block_b(x):
+            b1 = conv(x, 384, (3, 3), (2, 2))
+            b2 = conv(conv(conv(x, 64, (1, 1)), 96, (3, 3),
+                           padding=_same(3)), 96, (3, 3), (2, 2))
+            b3 = nn.max_pool(x, (3, 3), (2, 2))
+            return jnp.concatenate([b1, b2, b3], axis=-1)
+
+        def block_c(x, ch7):
+            b1 = conv(x, 192, (1, 1))
+            b2 = conv(conv(conv(x, ch7, (1, 1)), ch7, (1, 7),
+                           padding=[(0, 0), (3, 3)]), 192, (7, 1),
+                      padding=[(3, 3), (0, 0)])
+            b3 = conv(x, ch7, (1, 1))
+            b3 = conv(b3, ch7, (7, 1), padding=[(3, 3), (0, 0)])
+            b3 = conv(b3, ch7, (1, 7), padding=[(0, 0), (3, 3)])
+            b3 = conv(b3, ch7, (7, 1), padding=[(3, 3), (0, 0)])
+            b3 = conv(b3, 192, (1, 7), padding=[(0, 0), (3, 3)])
+            b4 = conv(nn.avg_pool(x, (3, 3), (1, 1), padding=_same(3)),
+                      192, (1, 1))
+            return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+        def block_d(x):
+            b1 = conv(conv(x, 192, (1, 1)), 320, (3, 3), (2, 2))
+            b2 = conv(conv(conv(conv(x, 192, (1, 1)), 192, (1, 7),
+                                padding=[(0, 0), (3, 3)]), 192, (7, 1),
+                           padding=[(3, 3), (0, 0)]), 192, (3, 3), (2, 2))
+            b3 = nn.max_pool(x, (3, 3), (2, 2))
+            return jnp.concatenate([b1, b2, b3], axis=-1)
+
+        def block_e(x):
+            b1 = conv(x, 320, (1, 1))
+            b2 = conv(x, 384, (1, 1))
+            b2 = jnp.concatenate(
+                [conv(b2, 384, (1, 3), padding=[(0, 0), (1, 1)]),
+                 conv(b2, 384, (3, 1), padding=[(1, 1), (0, 0)])], -1)
+            b3 = conv(conv(x, 448, (1, 1)), 384, (3, 3), padding=_same(3))
+            b3 = jnp.concatenate(
+                [conv(b3, 384, (1, 3), padding=[(0, 0), (1, 1)]),
+                 conv(b3, 384, (3, 1), padding=[(1, 1), (0, 0)])], -1)
+            b4 = conv(nn.avg_pool(x, (3, 3), (1, 1), padding=_same(3)),
+                      192, (1, 1))
+            return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+        x = x.astype(dt)
+        x = conv(x, 32, (3, 3), (2, 2))
+        x = conv(x, 32, (3, 3))
+        x = conv(x, 64, (3, 3), padding=_same(3))
+        x = nn.max_pool(x, (3, 3), (2, 2))
+        x = conv(x, 80, (1, 1))
+        x = conv(x, 192, (3, 3))
+        x = nn.max_pool(x, (3, 3), (2, 2))
+        x = block_a(x, 32)
+        x = block_a(x, 64)
+        x = block_a(x, 64)
+        x = block_b(x)
+        for ch7 in (128, 160, 160, 192):
+            x = block_c(x, ch7)
+        x = block_d(x)
+        x = block_e(x)
+        x = block_e(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes,
+                        dtype=dt)(x).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# factory (reference: vision/__init__.py:91-161 get_model)
+# ---------------------------------------------------------------------------
+
+def get_model(name: str, num_classes: int = 1000,
+              compute_dtype=jnp.float32, **kwargs):
+    """Model factory by gluon zoo name (e.g. ``"vgg16_bn"``,
+    ``"mobilenetv2_0.5"``, ``"densenet121"``, ``"resnet50_v1"``)."""
+    name = name.lower()
+    common = dict(num_classes=num_classes, compute_dtype=compute_dtype)
+    if name == "alexnet":
+        return AlexNet(**common, **kwargs)
+    if name.startswith("vgg"):
+        depth = int(name.removeprefix("vgg").removesuffix("_bn"))
+        layers, filters = _VGG_SPEC[depth]
+        return VGG(layers=layers, filters=filters,
+                   batch_norm=name.endswith("_bn"), **common, **kwargs)
+    if name.startswith("squeezenet"):
+        return SqueezeNet(version=name.removeprefix("squeezenet"),
+                          **common, **kwargs)
+    if name.startswith("mobilenetv2_"):
+        return MobileNetV2(multiplier=float(name.split("_")[1]),
+                           **common, **kwargs)
+    if name.startswith("mobilenet"):
+        return MobileNetV1(multiplier=float(name.removeprefix("mobilenet")),
+                           **common, **kwargs)
+    if name.startswith("densenet"):
+        init, growth, cfg = _DENSENET_SPEC[int(name.removeprefix("densenet"))]
+        return DenseNet(num_init_features=init, growth_rate=growth,
+                        block_config=cfg, **common, **kwargs)
+    if name == "inceptionv3":
+        return InceptionV3(**common, **kwargs)
+    if name.startswith("resnet"):
+        from geomx_tpu.models.resnet import create_resnet
+
+        base = name.split("_")[0]  # resnet50_v1 -> resnet50
+        return create_resnet(base, num_classes=num_classes,
+                             small_images=False,
+                             compute_dtype=compute_dtype)
+    raise ValueError(f"unknown model {name!r}")
