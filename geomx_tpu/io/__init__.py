@@ -1,3 +1,8 @@
 """Data loading (reference: src/io/ iterators + examples/utils.py loaders)."""
 
 from geomx_tpu.io.datasets import load_data, DataIter  # noqa: F401
+from geomx_tpu.io.iterators import (  # noqa: F401
+    CSVIter, LibSVMIter, NDArrayIter, PrefetchIter)
+from geomx_tpu.io.recordio import (  # noqa: F401
+    ImageRecordIter, IRHeader, MXRecordIO, pack, pack_array, unpack,
+    unpack_array)

@@ -1,0 +1,171 @@
+"""RecordIO pack format + image record iterator.
+
+Wire-compatible with the reference's RecordIO framing
+(reference: 3rdparty/dmlc-core/include/dmlc/recordio.h — magic
+``0xced7230a``, 29-bit length word, 4-byte alignment) and the
+``IRHeader`` record layout of python/mxnet/recordio.py (``IfQQ``:
+flag, float label, id, id2; ``flag > 0`` means flag extra float32
+labels follow the header).
+
+``ImageRecordIter`` (reference: src/io/iter_image_recordio_2.cc) here
+iterates packs whose payloads are RAW uint8 arrays of a fixed
+``data_shape`` — JPEG decode is deliberately out of scope (no image
+codec in the dependency set); ``pack_array``/``unpack_array`` are the
+raw-payload counterparts of mx.recordio.pack_img/unpack_img.
+"""
+
+from __future__ import annotations
+
+import struct
+from collections import namedtuple
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "IRHeader", "MXRecordIO", "pack", "unpack", "pack_array",
+    "unpack_array", "ImageRecordIter",
+]
+
+_MAGIC = 0xced7230a
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+IRHeader = namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    """Header + payload -> record body (reference: recordio.py pack)."""
+    label = header.label
+    if isinstance(label, (np.ndarray, list, tuple)):
+        label = np.asarray(label, np.float32)
+        header = header._replace(flag=label.size, label=0.0)
+        return (struct.pack(_IR_FORMAT, *header) + label.tobytes() + s)
+    return struct.pack(_IR_FORMAT, *header) + s
+
+
+def unpack(record: bytes) -> Tuple[IRHeader, bytes]:
+    header = IRHeader(*struct.unpack(_IR_FORMAT, record[:_IR_SIZE]))
+    body = record[_IR_SIZE:]
+    if header.flag > 0:
+        n = header.flag
+        label = np.frombuffer(body[:4 * n], np.float32)
+        header = header._replace(label=label)
+        body = body[4 * n:]
+    return header, body
+
+
+def pack_array(header: IRHeader, arr: np.ndarray) -> bytes:
+    """Raw-array payload (codec-free stand-in for pack_img)."""
+    return pack(header, np.ascontiguousarray(arr, np.uint8).tobytes())
+
+
+def unpack_array(record: bytes, shape: Sequence[int]
+                 ) -> Tuple[IRHeader, np.ndarray]:
+    header, body = unpack(record)
+    return header, np.frombuffer(body, np.uint8).reshape(shape)
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (dmlc framing)."""
+
+    def __init__(self, path: str, mode: str = "r"):
+        if mode not in ("r", "w"):
+            raise ValueError("mode must be 'r' or 'w'")
+        self.path = path
+        self.mode = mode
+        self._f = open(path, "rb" if mode == "r" else "wb")
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def write(self, data: bytes) -> None:
+        assert self.mode == "w"
+        if len(data) >= (1 << 29):
+            raise ValueError("record too large (multi-part cflag records "
+                             "not supported)")
+        self._f.write(struct.pack("<II", _MAGIC, len(data)))
+        self._f.write(data)
+        pad = (-len(data)) % 4
+        if pad:
+            self._f.write(b"\x00" * pad)
+
+    def read(self) -> Optional[bytes]:
+        assert self.mode == "r"
+        head = self._f.read(8)
+        if len(head) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _MAGIC:
+            raise IOError(f"bad RecordIO magic {magic:#x} in {self.path}")
+        cflag, length = lrec >> 29, lrec & ((1 << 29) - 1)
+        if cflag != 0:
+            raise IOError("multi-part records not supported")
+        data = self._f.read(length)
+        if len(data) < length:
+            raise IOError(f"truncated record in {self.path}")
+        pad = (-length) % 4
+        if pad:
+            self._f.read(pad)
+        return data
+
+    def reset(self) -> None:
+        self._f.seek(0)
+
+
+class ImageRecordIter:
+    """Batched iterator over a raw-payload RecordIO pack
+    (reference: iter_image_recordio_2.cc, minus JPEG decode).
+
+    Yields ``(data [B,*data_shape] float32 in [0,1], label [B])``; the
+    tail batch pads from the file head (reference round_batch
+    behavior).
+    """
+
+    def __init__(self, path_imgrec: str, data_shape: Sequence[int],
+                 batch_size: int, shuffle: bool = False, seed: int = 0):
+        self.data_shape = tuple(data_shape)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+        imgs: List[np.ndarray] = []
+        labels: List[float] = []
+        with MXRecordIO(path_imgrec, "r") as rec:
+            while True:
+                raw = rec.read()
+                if raw is None:
+                    break
+                header, arr = unpack_array(raw, self.data_shape)
+                lab = header.label
+                labels.append(float(np.asarray(lab).ravel()[0]))
+                imgs.append(arr)
+        self.data = (np.stack(imgs).astype(np.float32) / 255.0
+                     if imgs else
+                     np.zeros((0, *self.data_shape), np.float32))
+        self.label = np.asarray(labels, np.float32)
+
+    def reset(self) -> None:
+        pass
+
+    def __len__(self) -> int:
+        return -(-len(self.data) // self.batch_size)
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        n = len(self.data)
+        if n == 0:
+            return
+        idx = np.arange(n)
+        if self.shuffle:
+            self._rng.shuffle(idx)
+        bs = self.batch_size
+        for i in range(len(self)):
+            sel = idx[i * bs:(i + 1) * bs]
+            if len(sel) < bs:  # pad from head (round_batch)
+                sel = np.concatenate([sel, idx[:bs - len(sel)]])
+            yield self.data[sel], self.label[sel]

@@ -1,0 +1,144 @@
+"""Data iterator family + RecordIO (reference: src/io/, mx.io, mx.recordio)."""
+
+import numpy as np
+import pytest
+
+from geomx_tpu.io import (
+    CSVIter, ImageRecordIter, IRHeader, LibSVMIter, MXRecordIO,
+    NDArrayIter, PrefetchIter, pack, pack_array, unpack, unpack_array)
+
+
+def _data(n=10):
+    return np.arange(n * 4, dtype=np.float32).reshape(n, 4), \
+        np.arange(n, dtype=np.int32)
+
+
+def test_ndarray_iter_pad_wraps_head():
+    X, y = _data(10)
+    batches = list(NDArrayIter(X, y, batch_size=4, last_batch_handle="pad"))
+    assert len(batches) == 3
+    assert all(b[0].shape == (4, 4) for b in batches)
+    # tail batch = samples 8,9 + head samples 0,1
+    np.testing.assert_array_equal(batches[2][1], [8, 9, 0, 1])
+
+
+def test_ndarray_iter_discard():
+    X, y = _data(10)
+    it = NDArrayIter(X, y, batch_size=4, last_batch_handle="discard")
+    assert len(list(it)) == 2 and len(it) == 2
+
+
+def test_ndarray_iter_roll_over_carries_tail():
+    X, y = _data(10)
+    it = NDArrayIter(X, y, batch_size=4, last_batch_handle="roll_over")
+    assert len(list(it)) == 2
+    # epoch 2 starts with the carried samples 8, 9
+    epoch2 = list(it)
+    np.testing.assert_array_equal(epoch2[0][1][:2], [8, 9])
+    it.reset()
+    assert len(list(it)) == 2  # reset drops the carry
+
+
+def test_ndarray_iter_shuffle_covers_all():
+    X, y = _data(8)
+    it = NDArrayIter(X, y, batch_size=4, shuffle=True, seed=1)
+    seen = np.concatenate([b[1] for b in it])
+    assert sorted(seen.tolist()) == list(range(8))
+
+
+def test_csv_iter(tmp_path):
+    X, y = _data(6)
+    data_csv = tmp_path / "d.csv"
+    label_csv = tmp_path / "l.csv"
+    np.savetxt(data_csv, X, delimiter=",")
+    np.savetxt(label_csv, y, delimiter=",")
+    it = CSVIter(str(data_csv), data_shape=(2, 2), batch_size=3,
+                 label_csv=str(label_csv))
+    batches = list(it)
+    assert batches[0][0].shape == (3, 2, 2)
+    np.testing.assert_allclose(
+        np.concatenate([b[0] for b in batches]).reshape(6, 4), X)
+    with pytest.raises(ValueError, match="row width"):
+        CSVIter(str(data_csv), data_shape=(3,), batch_size=2)
+
+
+def test_libsvm_iter(tmp_path):
+    p = tmp_path / "d.svm"
+    p.write_text("1 0:1.5 3:2.0\n0 1:7.0\n1 2:1.0 0:4.0\n")
+    it = LibSVMIter(str(p), data_shape=(4,), batch_size=2)
+    X, y = next(iter(it))
+    np.testing.assert_allclose(X, [[1.5, 0, 0, 2.0], [0, 7.0, 0, 0]])
+    np.testing.assert_allclose(y, [1, 0])
+    bad = tmp_path / "bad.svm"
+    bad.write_text("1 9:1.0\n")
+    with pytest.raises(ValueError, match="out of range"):
+        LibSVMIter(str(bad), data_shape=(4,), batch_size=1)
+
+
+def test_prefetch_iter_same_sequence_and_errors():
+    X, y = _data(12)
+    base = NDArrayIter(X, y, batch_size=4)
+    pre = PrefetchIter(NDArrayIter(X, y, batch_size=4), prefetch=3)
+    for (a, la), (b, lb) in zip(base, pre):
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(la, lb)
+
+    class Boom:
+        def __iter__(self):
+            yield _data(1)
+            raise RuntimeError("producer died")
+
+    with pytest.raises(RuntimeError, match="producer died"):
+        list(PrefetchIter(Boom()))
+
+
+def test_recordio_round_trip(tmp_path):
+    p = tmp_path / "x.rec"
+    payloads = [b"alpha", b"bb", b"", b"0123456789" * 100]
+    with MXRecordIO(str(p), "w") as w:
+        for b in payloads:
+            w.write(b)
+    with MXRecordIO(str(p), "r") as r:
+        got = []
+        while True:
+            item = r.read()
+            if item is None:
+                break
+            got.append(item)
+    assert got == payloads
+
+
+def test_recordio_header_pack_scalar_and_vector():
+    h = IRHeader(0, 3.0, 7, 0)
+    rec = pack(h, b"payload")
+    h2, body = unpack(rec)
+    assert h2.label == 3.0 and h2.id == 7 and body == b"payload"
+    hv = IRHeader(0, np.array([1.0, 2.0, 3.0], np.float32), 9, 0)
+    h3, body3 = unpack(pack(hv, b"zz"))
+    np.testing.assert_allclose(h3.label, [1.0, 2.0, 3.0])
+    assert body3 == b"zz"
+
+
+def test_image_record_iter(tmp_path):
+    p = tmp_path / "imgs.rec"
+    shape = (4, 4, 3)
+    rng = np.random.RandomState(0)
+    imgs = [rng.randint(0, 256, shape, np.uint8) for _ in range(5)]
+    with MXRecordIO(str(p), "w") as w:
+        for i, img in enumerate(imgs):
+            w.write(pack_array(IRHeader(0, float(i % 2), i, 0), img))
+    it = ImageRecordIter(str(p), data_shape=shape, batch_size=2)
+    batches = list(it)
+    assert len(batches) == 3  # 5 samples, tail padded from head
+    X0, y0 = batches[0]
+    assert X0.shape == (2, 4, 4, 3) and X0.dtype == np.float32
+    np.testing.assert_allclose(X0[0], imgs[0].astype(np.float32) / 255.0)
+    np.testing.assert_allclose(y0, [0.0, 1.0])
+
+
+def test_recordio_rejects_corrupt_magic(tmp_path):
+    p = tmp_path / "bad.rec"
+    p.write_bytes(b"\x00" * 16)
+    with MXRecordIO(str(p), "r") as r:
+        with pytest.raises(IOError, match="magic"):
+            r.read()
