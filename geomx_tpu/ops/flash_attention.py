@@ -285,6 +285,12 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
     if q.ndim != 4:
         raise ValueError(f"expected [B, T, H, D] tensors, got {q.shape}")
     Tq, Tk = q.shape[1], k.shape[1]
+    if causal and Tq > Tk:
+        # no decode-convention alignment exists for more queries than
+        # keys; without this check, q rows with zero visible keys would
+        # silently emit the value-block mean (online-softmax artifact)
+        raise ValueError(
+            f"causal attention needs Tq <= Tk, got Tq={Tq} > Tk={Tk}")
     bq, bk = min(block_q, _round_up(Tq, 8)), min(block_k, _round_up(Tk, 8))
     interpret = jax.default_backend() != "tpu"
 

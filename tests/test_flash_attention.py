@@ -160,3 +160,10 @@ def test_causal_decode_offset():
     g = jax.grad(lambda q: flash_attention(
         q, k, v, causal=True, block_q=8, block_k=8).sum())(q)
     assert bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_causal_rejects_more_queries_than_keys():
+    q = _rand((1, 16, 1, 8), 0)
+    k = _rand((1, 12, 1, 8), 1)
+    with pytest.raises(ValueError, match="Tq <= Tk"):
+        flash_attention(q, k, k, causal=True, block_q=8, block_k=8)
