@@ -1,0 +1,107 @@
+"""Mixture-of-Experts FFN with expert parallelism over the "ep" axis.
+
+Beyond the reference (its op set predates MoE; SURVEY.md §2.3 — the
+rubric's EP axis). TPU-first design: expert weights are STACKED along a
+leading expert dimension and sharded ``P("ep", ...)``; dispatch/combine
+are einsums against the router's one-hot assignment, so GSPMD inserts
+the expert-parallel collectives (all-to-all / reduce-scatter patterns)
+from the shardings alone — no hand-written routing transport.
+
+Documented divergence from capacity-factor MoE systems: every expert
+computes every token and the router mask zeroes non-selected outputs
+("dense dispatch"). That keeps shapes static (XLA-friendly, no token
+dropping) at the cost of E-times FFN FLOPs — the EXPERT-PARALLEL
+sharding story (weights + compute split over "ep") is identical, which
+is what the EP axis is about; capacity-based sparse dispatch is a
+host-level optimization layered later.
+
+Router: top-1 (Switch-style) with optional jitter noise and the
+standard load-balancing auxiliary loss (mean fraction x mean gate per
+expert, scaled by E).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["MoEBlock", "moe_param_sharding"]
+
+
+class MoEBlock(nn.Module):
+    """Drop-in FFN block: LayerNorm -> top-1 MoE MLP -> residual."""
+
+    dim: int
+    num_experts: int = 4
+    mlp_ratio: int = 4
+    jitter: float = 0.0
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dt = self.compute_dtype
+        E, D, H = self.num_experts, self.dim, self.mlp_ratio * self.dim
+        h = nn.LayerNorm(dtype=dt, name="ln")(x)
+
+        # router (f32 for a stable softmax/argmax)
+        logits = nn.Dense(E, dtype=jnp.float32, name="router")(
+            h.astype(jnp.float32))
+        if train and self.jitter > 0.0:
+            rng = self.make_rng("router")
+            logits = logits * jax.random.uniform(
+                rng, logits.shape, minval=1.0 - self.jitter,
+                maxval=1.0 + self.jitter)
+        gates = jax.nn.softmax(logits, axis=-1)           # [B, T, E]
+        expert_idx = jnp.argmax(gates, axis=-1)           # [B, T]
+        onehot = jax.nn.one_hot(expert_idx, E, dtype=gates.dtype)
+        gate_val = jnp.sum(gates * onehot, axis=-1)       # [B, T]
+
+        # load-balancing aux loss (Switch Transformer eq. 4-6)
+        frac_tokens = jnp.mean(onehot, axis=(0, 1))       # [E]
+        frac_gates = jnp.mean(gates, axis=(0, 1))         # [E]
+        self.sow("losses", "moe_aux",
+                 E * jnp.sum(frac_tokens * frac_gates))
+
+        # expert-stacked MLP params: [E, D, H] / [E, H, D] — shard the
+        # leading axis over "ep" (moe_param_sharding)
+        w_up = self.param("w_up", nn.initializers.lecun_normal(),
+                          (E, D, H), jnp.float32).astype(dt)
+        b_up = self.param("b_up", nn.initializers.zeros,
+                          (E, H), jnp.float32).astype(dt)
+        w_dn = self.param("w_dn", nn.initializers.lecun_normal(),
+                          (E, H, D), jnp.float32).astype(dt)
+        b_dn = self.param("b_dn", nn.initializers.zeros,
+                          (E, D), jnp.float32).astype(dt)
+
+        # dense dispatch: every expert runs every token; the einsum over
+        # E contracts against the router mask, and with w_* sharded over
+        # "ep" GSPMD turns this into expert-parallel compute + a psum
+        he = jnp.einsum("btd,edh->ebth", h, w_up) + b_up[:, None, None]
+        he = nn.gelu(he)
+        ye = jnp.einsum("ebth,ehd->ebtd", he, w_dn) + b_dn[:, None, None]
+        mask = (onehot * gate_val[..., None]).astype(dt)  # [B, T, E]
+        y = jnp.einsum("bte,ebtd->btd", mask, ye)
+        return x + y.astype(x.dtype)
+
+
+def moe_param_sharding(mesh: Mesh):
+    """device_put MoE params with experts over "ep" (router/norm
+    replicated)."""
+
+    def shard(params):
+        def put(path_entries, leaf):
+            path = "/".join(str(getattr(p, "key", p)) for p in path_entries)
+            if any(path.endswith(s) for s in
+                   ("w_up", "b_up", "w_dn", "b_dn")):
+                spec = P(*(["ep"] + [None] * (leaf.ndim - 1)))
+            else:
+                spec = P()
+            return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+        return jax.tree_util.tree_map_with_path(put, params)
+
+    return shard

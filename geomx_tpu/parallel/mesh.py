@@ -7,6 +7,11 @@ inserts the collectives (psum over "dp", all-gather/reduce-scatter over
 - "dp": data parallel (batch dimension)
 - "tp": tensor parallel (hidden/feature dimension)
 - "sp": sequence/context parallel (sequence dimension; ring attention)
+- "pp": pipeline parallel (depth/stage dimension; parallel.pipeline)
+- "ep": expert parallel (MoE expert dimension; models.moe)
+
+pp/ep default to 1 and add mesh axes only when requested, so existing
+3-axis call sites and shardings are unchanged.
 """
 
 from __future__ import annotations
@@ -18,22 +23,27 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: F401
 
 AXES = ("dp", "tp", "sp")
+AXES5 = ("dp", "tp", "sp", "pp", "ep")
 
 
-def mesh_shape_for(n_devices: int, tp: int = 1, sp: int = 1) -> Tuple[int, int, int]:
-    """Factor n_devices into (dp, tp, sp) given tp/sp requests."""
-    assert n_devices % (tp * sp) == 0, (
-        f"n_devices={n_devices} not divisible by tp*sp={tp * sp}")
-    return (n_devices // (tp * sp), tp, sp)
+def mesh_shape_for(n_devices: int, tp: int = 1, sp: int = 1,
+                   pp: int = 1, ep: int = 1) -> Tuple[int, ...]:
+    """Factor n_devices into (dp, tp, sp[, pp, ep]) given requests."""
+    denom = tp * sp * pp * ep
+    assert n_devices % denom == 0, (
+        f"n_devices={n_devices} not divisible by tp*sp*pp*ep={denom}")
+    if pp == 1 and ep == 1:
+        return (n_devices // denom, tp, sp)
+    return (n_devices // denom, tp, sp, pp, ep)
 
 
 def make_mesh(devices: Optional[Sequence] = None, tp: int = 1,
-              sp: int = 1) -> Mesh:
+              sp: int = 1, pp: int = 1, ep: int = 1) -> Mesh:
     if devices is None:
         devices = jax.devices()
-    dp, tp, sp = mesh_shape_for(len(devices), tp, sp)
-    arr = np.asarray(devices).reshape(dp, tp, sp)
-    return Mesh(arr, AXES)
+    shape = mesh_shape_for(len(devices), tp, sp, pp, ep)
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, AXES if len(shape) == 3 else AXES5)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
