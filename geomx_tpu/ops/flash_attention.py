@@ -94,9 +94,12 @@ def _kernels(Tq: int, Tk: int, D: int, block_q: int, block_k: int,
 
         @pl.when(_live(qi, kj))
         def _():
-            q = q_ref[0, 0].astype(jnp.float32)
-            kb = k_ref[0, 0].astype(jnp.float32)
-            vb = v_ref[0, 0].astype(jnp.float32)
+            # matmul operands stay in the INPUT dtype (bf16 runs the MXU
+            # at full rate; an up-front f32 cast would halve it) with
+            # f32 accumulation; softmax math is f32
+            q = q_ref[0, 0]
+            kb = k_ref[0, 0]
+            vb = v_ref[0, 0]
             s = jax.lax.dot_general(
                 q, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
@@ -106,8 +109,9 @@ def _kernels(Tq: int, Tk: int, D: int, block_q: int, block_k: int,
             p = jnp.exp(s - m_new[:, None])
             alpha = jnp.exp(m - m_new)
             l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
-            acc_ref[:] = acc_ref[:] * alpha[:, None] + jnp.dot(
-                p, vb, preferred_element_type=jnp.float32)
+            acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+                p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
             m_ref[:, 0] = m_new
 
         @pl.when(kj == nk - 1)
@@ -158,12 +162,12 @@ def _kernels(Tq: int, Tk: int, D: int, block_q: int, block_k: int,
 
         @pl.when(_live(qi, kj))
         def _():
-            q = q_ref[0, 0].astype(jnp.float32)
-            do = do_ref[0, 0].astype(jnp.float32)
+            q = q_ref[0, 0]
+            do = do_ref[0, 0]
             lse = lse_ref[0, 0, :, 0]
             delta = delta_ref[0, 0, :, 0]
-            kb = k_ref[0, 0].astype(jnp.float32)
-            vb = v_ref[0, 0].astype(jnp.float32)
+            kb = k_ref[0, 0]
+            vb = v_ref[0, 0]
             s = jax.lax.dot_general(
                 q, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
@@ -171,9 +175,10 @@ def _kernels(Tq: int, Tk: int, D: int, block_q: int, block_k: int,
             dp = jax.lax.dot_general(
                 do, vb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
-            ds = p * (dp - delta[:, None]) * scale
-            acc_ref[:] = acc_ref[:] + jnp.dot(
-                ds, kb, preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta[:, None]) * scale).astype(kb.dtype)
+            acc_ref[:] = acc_ref[:] + jax.lax.dot_general(
+                ds, kb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
 
         @pl.when(kj == nk - 1)
         def _():
@@ -210,23 +215,24 @@ def _kernels(Tq: int, Tk: int, D: int, block_q: int, block_k: int,
 
         @pl.when(_live(qi, kj))
         def _():
-            kb = k_ref[0, 0].astype(jnp.float32)
-            vb = v_ref[0, 0].astype(jnp.float32)
-            qb = q_ref[0, 0].astype(jnp.float32)
-            dob = do_ref[0, 0].astype(jnp.float32)
+            kb = k_ref[0, 0]
+            vb = v_ref[0, 0]
+            qb = q_ref[0, 0]
+            dob = do_ref[0, 0]
             lse = lse_ref[0, 0, :, 0]
             delta = delta_ref[0, 0, :, 0]
             s = jax.lax.dot_general(
                 qb, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
             p = jnp.where(_mask(qi, kj), jnp.exp(s - lse[:, None]), 0.0)
+            pb = p.astype(dob.dtype)
             dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
-                p, dob, (((0,), (0,)), ((), ())),
+                pb, dob, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
             dp = jax.lax.dot_general(
                 dob, vb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
-            ds = p * (dp - delta[:, None]) * scale
+            ds = (p * (dp - delta[:, None]) * scale).astype(qb.dtype)
             dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
                 ds, qb, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
