@@ -8,18 +8,20 @@ Prints ONE JSON line:
 What is measured (round-2 verdict item 2 — the previous bench measured a
 bare jax+optax step and swung 4.6x between driver captures):
 
-1. ``hips``   — the flagship path: workers training the demo CNN through
-   KVStoreDist over a LIVE two-party HiPS topology (schedulers/servers/
-   master as CPU threads via geomx_tpu.simulate, every byte through the
-   real transport; worker compute jitted on the chip). Steady-state
-   throughput is the MEDIAN of 3 trials of >=10s each (>=30s total) plus
-   a fixed-iteration accuracy probe.
-2. ``nokv``   — the same model/step single-chip with optax, no kvstore:
+1. ``hips_bsc`` (HEADLINE) — the BASELINE.md target config: HiPS with
+   Bi-Sparse on, run the TPU-native way (geomx_tpu.trainer_device):
+   params device-resident, BSC top-k on device, only compact payloads
+   on the host<->device link, PS tier aggregating over the LIVE
+   two-party topology (every byte through the real transport).
+2. ``hips``   — vanilla FSA through KVStoreDist (server-side Adam),
+   full dense weights/grads each round. Steady-state throughput is the
+   MEDIAN of 3 trials of >=10s each plus a fixed-iteration accuracy
+   probe (both configs).
+3. ``nokv``   — the same model/step single-chip with optax, no kvstore:
    the framework-overhead denominator and the accuracy-parity baseline.
-3. ``transformer_mfu`` — a 26M-param decoder-only transformer train step
-   (bf16, seq 512) single-chip, reported as model-FLOPs utilization
-   against the chip's peak — the number that says how well the compute
-   path maps to the MXU.
+4. ``transformer_mfu`` — a 26M-param decoder-only transformer train step
+   (bf16, seq 512) single-chip, dense and Pallas-flash attention,
+   reported as model-FLOPs utilization against the chip's peak.
 
 vs_baseline follows BASELINE.md: the reference's headline config is its
 demo CNN through the full HiPS stack; the target is >=0.9x the per-chip
@@ -32,6 +34,7 @@ reference publishes no number, so the documented estimate
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import statistics
@@ -88,17 +91,14 @@ def bench_nokv():
 
     train_iter, test_iter, _, _ = load_data(bs, 1, 0)
     X0_np, y0_np = next(iter(train_iter))
-    # accuracy probe: ACC_ITERS real iterations
-    it = 0
-    for _ in range(10):
-        for X, y in train_iter:
-            leaves, opt_state, loss = step(
-                leaves, opt_state, jnp.asarray(X), jnp.asarray(y))
-            it += 1
-            if it >= ACC_ITERS:
-                break
-        if it >= ACC_ITERS:
-            break
+    # accuracy probe: ACC_ITERS iterations cycling a device-cached
+    # batch set (streaming 100 distinct batches through the tunnel
+    # would make upload bandwidth, not training, the phase cost)
+    probe = [(jnp.asarray(X), jnp.asarray(y))
+             for X, y in itertools.islice(train_iter, 8)]
+    for it in range(ACC_ITERS):
+        X, y = probe[it % len(probe)]
+        leaves, opt_state, loss = step(leaves, opt_state, X, y)
     acc = eval_acc(test_iter, leaves, eval_step)
     # throughput: steady state on one cached device-resident batch
     X0, y0 = jnp.asarray(X0_np), jnp.asarray(y0_np)
@@ -194,7 +194,7 @@ def bench_hips():
             kv.wait()
             train_iter, test_iter, _, _ = load_data(bs, 2, widx)
             batches = [(jnp.asarray(X), jnp.asarray(y))
-                       for X, y in list(train_iter)[:8]]
+                       for X, y in itertools.islice(train_iter, 8)]
 
             def one_round(X, y):
                 # ONE fused host->device transfer for params and ONE
@@ -210,16 +210,11 @@ def bench_hips():
                     kv.pull(idx, out=leaves[idx], priority=-idx)
                 kv.wait()
 
-            # phase A: fixed-iteration accuracy probe on real batches
-            it = 0
-            for _ in range(50):
-                for X, y in train_iter:
-                    one_round(jnp.asarray(X), jnp.asarray(y))
-                    it += 1
-                    if it >= ACC_ITERS:
-                        break
-                if it >= ACC_ITERS:
-                    break
+            # phase A: fixed-iteration accuracy probe cycling the
+            # device-cached batch set (see bench_nokv's probe note)
+            for it in range(ACC_ITERS):
+                X, y = batches[it % len(batches)]
+                one_round(X, y)
             accs[widx] = eval_acc(test_iter, leaves, eval_step)
             phase_a_done[widx] = True
             if all(phase_a_done):
@@ -264,6 +259,85 @@ def bench_hips():
         topo.stop()
 
 
+def bench_hips_bsc(threshold: float = 0.02):
+    """The BASELINE.md target config: HiPS with Bi-Sparse ON, via the
+    device-resident trainer (params never leave the chip; the
+    host<->device link carries only the BSC top-k selection down and
+    the aggregated nonzeros up — geomx_tpu.trainer_device). PS tier is
+    an aggregator (cnn_bsc semantics: worker-side optimizer)."""
+    import jax
+    import jax.numpy as jnp
+
+    from examples.utils import build_model_and_step, eval_acc
+    from geomx_tpu.io import load_data
+    from geomx_tpu.simulate import InProcessHiPS
+    from geomx_tpu.trainer_device import DeviceResidentTrainer
+
+    topo = InProcessHiPS(num_parties=2, workers_per_party=1).start()
+    try:
+        bs = BATCH_PER_WORKER
+        leaves0, _td, grad_step, eval_step = build_model_and_step(bs)
+        rounds = [0, 0]
+        accs = [0.0, 0.0]
+        stop_round = [None]
+        phase_b = threading.Event()
+        phase_a_done = [False, False]
+        # each trainer traces its own jitted fns; serializing the FIRST
+        # step lets the second worker's compile hit the persistent
+        # compilation cache instead of compiling concurrently (tunnel
+        # compiles are expensive)
+        compile_lock = threading.Lock()
+
+        def master_init(kv):
+            for idx, leaf in enumerate(leaves0):
+                kv.init(idx, np.array(leaf))
+            kv.wait()
+
+        def worker(kv):
+            widx = 0 if kv is topo.workers[0] else 1
+            tr = DeviceResidentTrainer(
+                list(leaves0), kv, grad_step, threshold=threshold,
+                learning_rate=0.05, momentum=0.0)
+            train_iter, test_iter, _, _ = load_data(bs, 2, widx)
+            batches = [(jnp.asarray(X), jnp.asarray(y))
+                       for X, y in itertools.islice(train_iter, 8)]
+            with compile_lock:
+                # trace+compile outside the FSA round (tr.step would
+                # barrier on the peer, deadlocking against the lock)
+                tr.warmup(*batches[0])
+            for it in range(ACC_ITERS):
+                X, y = batches[it % len(batches)]
+                tr.step(X, y)
+            accs[widx] = eval_acc(test_iter, tr.leaves, eval_step)
+            phase_a_done[widx] = True
+            if all(phase_a_done):
+                phase_b.set()
+            i = 0
+            while stop_round[0] is None or rounds[widx] < stop_round[0]:
+                X, y = batches[i % len(batches)]
+                tr.step(X, y)
+                rounds[widx] += 1
+                i += 1
+
+        runner, runner_err = _spawn_hips_workers(topo, worker, master_init,
+                                                 phase_b)
+        if not phase_b.wait(900.0):
+            raise TimeoutError("BSC accuracy phase did not complete")
+        if runner_err:
+            raise runner_err[0]
+        time.sleep(2.0)
+        per_trial = _measure_trials(lambda: rounds[0] + rounds[1],
+                                    runner_err, bs)
+        stop_round[0] = max(rounds) + 2
+        runner.join(120.0)
+        return {"img_s": statistics.median(per_trial),
+                "acc": float(min(accs)),
+                "threshold": threshold,
+                "trials": [round(x, 1) for x in per_trial]}
+    finally:
+        topo.stop()
+
+
 def bench_hips_hfa(hfa_k1: int = 4, hfa_k2: int = 2):
     """HFA flavor of the framework bench: workers take K1 LOCAL optimizer
     steps per LAN sync, and the party tier crosses the WAN only every K2
@@ -304,7 +378,7 @@ def bench_hips_hfa(hfa_k1: int = 4, hfa_k2: int = 2):
             kv.wait()
             train_iter, _te, _n, _m = load_data(bs, 2, widx)
             batches = [(jnp.asarray(X), jnp.asarray(y))
-                       for X, y in list(train_iter)[:8]]
+                       for X, y in itertools.islice(train_iter, 8)]
             nlw = kv.num_workers
             i = 0
             while stop_round[0] is None or iters[widx] < stop_round[0]:
@@ -426,12 +500,21 @@ def _setup_jax():
         pass
 
 
+def _phase(name: str):
+    import sys
+
+    print(f"[bench] {name} @ {time.strftime('%H:%M:%S')}",
+          file=sys.stderr, flush=True)
+
+
 def main():
     _setup_jax()
     details = {}
+    _phase("nokv")
     nokv = bench_nokv()
     details["nokv_cnn"] = {"img_s": round(nokv["img_s"], 1),
                            "acc_at_100_iters": round(nokv["acc"], 4)}
+    _phase("hips (vanilla FSA)")
     hips = bench_hips()
     details["hips_cnn"] = {"img_s": round(hips["img_s"], 1),
                            "acc_at_100_iters": round(hips["acc"], 4),
@@ -439,6 +522,15 @@ def main():
     details["framework_overhead"] = round(
         nokv["img_s"] / max(hips["img_s"], 1e-9), 2)
     details["accuracy_parity"] = round(hips["acc"] - nokv["acc"], 4)
+    # the BASELINE.md target config (HiPS + Bi-Sparse): headline metric
+    _phase("hips_bsc (device-resident)")
+    bsc = bench_hips_bsc()
+    details["hips_bsc_cnn"] = {"img_s": round(bsc["img_s"], 1),
+                               "acc_at_100_iters": round(bsc["acc"], 4),
+                               "threshold": bsc["threshold"],
+                               "trials": bsc["trials"]}
+    details["bsc_accuracy_parity"] = round(bsc["acc"] - nokv["acc"], 4)
+    _phase("hips_hfa")
     try:
         hfa = bench_hips_hfa()
         details["hips_hfa_cnn"] = {"img_s": round(hfa["img_s"], 1),
@@ -446,6 +538,7 @@ def main():
                                    "trials": hfa["trials"]}
     except Exception as e:  # noqa: BLE001 — secondary metric
         details["hips_hfa_cnn"] = {"error": str(e)}
+    _phase("transformer")
     import jax
 
     # fixed keys so the schema is stable run-to-run: "transformer" is
@@ -471,10 +564,10 @@ def main():
         details["env_note"] = "chip behind network tunnel; host<->device " \
             "latency dominates hips_cnn"
     print(json.dumps({
-        "metric": "hips_cnn_images_per_sec_per_chip",
-        "value": round(hips["img_s"], 1),
+        "metric": "hips_bsc_cnn_images_per_sec_per_chip",
+        "value": round(bsc["img_s"], 1),
         "unit": "images/sec/chip",
-        "vs_baseline": round(hips["img_s"] / (0.9 * V100_HIPS_IMG_S), 3),
+        "vs_baseline": round(bsc["img_s"] / (0.9 * V100_HIPS_IMG_S), 3),
         "details": details,
     }))
 

@@ -1,0 +1,204 @@
+"""Device-resident sparse trainer: params never leave the accelerator.
+
+The TPU-native flagship worker loop for the BASELINE.md target config
+(HiPS + Bi-Sparse). The plain ``Trainer`` round-trips every parameter
+and gradient through host memory each step — fine when the chip is
+PCIe-local, ruinous when it is not, and wasteful everywhere. Here the
+parameters stay resident on the device as one flat fp32 vector and the
+host<->device link carries only:
+
+- down: the BSC-selected (values, indices) of the momentum-corrected
+  gradient (``ops.bsc_compress`` — top-k on device, reference
+  semantics: gradient_compression.cc:191 BSCompress);
+- up: the nonzeros of the aggregated gradient pulled back from the
+  HiPS tier (bounded by workers x k).
+
+KVStore semantics follow examples/cnn_bsc.py: the PS tier is an
+AGGREGATOR (no server-side optimizer); every worker applies the same
+optimizer step locally on the identical aggregated sparse gradient, so
+replicas stay bit-identical without shipping weights. Worker pushes are
+scaled by 1/num_workers so the aggregated sum is the mean gradient.
+
+The local optimizer is SGD (+momentum) as a jitted sparse-aware update:
+momentum state is dense on device; untouched coordinates still decay,
+touched ones get the aggregated gradient (dense-momentum-on-sparse-
+grads, the standard treatment).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["DeviceResidentTrainer"]
+
+
+class DeviceResidentTrainer:
+    def __init__(self, params: Sequence[Any], kvstore,
+                 grad_fn: Callable, threshold: float = 0.01,
+                 learning_rate: float = 0.01, momentum: float = 0.0,
+                 begin_key: int = 0):
+        """``params``: list of array leaves (key of leaf i =
+        ``begin_key + i``); ``grad_fn(leaf_list, X, y) -> (loss,
+        grad_leaves)`` must be jit-compatible (it is traced into the
+        fused device step)."""
+        import jax
+        import jax.numpy as jnp
+
+        self.kv = kvstore
+        self.begin_key = begin_key
+        self.threshold = threshold
+        self.learning_rate = learning_rate
+        self.momentum = momentum
+
+        leaves = [np.asarray(p, np.float32) for p in params]
+        self._shapes = [l.shape for l in leaves]
+        self._sizes = [int(l.size) for l in leaves]
+        self._offsets = np.concatenate(
+            [[0], np.cumsum(self._sizes)]).astype(np.int64)
+        self.total = int(self._offsets[-1])
+        self.k = max(int(self.total * threshold), 1)
+        bounds = list(self._offsets[1:-1])
+
+        # kv bootstrap: init + pull once (the only full-weight transfer)
+        for i, leaf in enumerate(leaves):
+            self.kv.init(begin_key + i, leaf)
+        if not getattr(self.kv, "is_master_worker", False):
+            for i in range(len(leaves)):
+                self.kv.pull(begin_key + i, out=leaves[i])
+        self.kv.wait()
+
+        flat0 = np.concatenate([l.ravel() for l in leaves])
+        self._flat = jax.device_put(jnp.asarray(flat0))
+        self._u = jax.device_put(jnp.zeros(self.total, jnp.float32))
+        self._v = jax.device_put(jnp.zeros(self.total, jnp.float32))
+        self._mom = (jax.device_put(jnp.zeros(self.total, jnp.float32))
+                     if momentum else None)
+
+        shapes, k = self._shapes, self.k
+        # scale by the TOTAL worker count across parties (the global
+        # tier sums every party's aggregate), not the party-local count
+        nw = max(int(getattr(self.kv, "num_all_workers", 0)
+                     or getattr(self.kv, "num_workers", 1)), 1)
+        self._num_workers = nw
+        # the aggregate has <= nw*k nonzeros; padding the upload to that
+        # FIXED size keeps one compiled apply (a shape that varied per
+        # round would retrace/recompile jit every step)
+        self._up_cap = m = nw * k
+        # indices ride the float32 payload (exact below 2^24)
+        if self.total >= 1 << 24:
+            raise ValueError("DeviceResidentTrainer supports < 2^24 "
+                             f"parameters per trainer, got {self.total}")
+
+        @jax.jit
+        def fwd_compress(flat, u, v, X, y):
+            lv = [p.reshape(s) for p, s in
+                  zip(jnp.split(flat, bounds), shapes)]
+            loss, grads = grad_fn(lv, X, y)
+            g = jnp.concatenate([gg.reshape(-1) for gg in grads]) / nw
+            # BSC: momentum-corrected accumulation, exact top-k
+            # (reference: gradient_compression.cc:191-268)
+            u = 0.9 * u + g
+            v = v + u
+            _mags, idx = jax.lax.top_k(jnp.abs(v), k)
+            vals = v[idx]
+            v = v.at[idx].set(0.0)
+            u = u.at[idx].set(0.0)
+            # single packed transfer: [loss, vals(k), idx(k) as f32]
+            packed = jnp.concatenate(
+                [loss[None].astype(jnp.float32), vals,
+                 idx.astype(jnp.float32)])
+            return packed, u, v
+
+        @jax.jit
+        def apply_sgd(flat, mom, packed):
+            vals, fidx = packed[:m], packed[m:]
+            g = jnp.zeros_like(flat).at[fidx.astype(jnp.int32)].add(vals)
+            if mom is None:
+                return flat - learning_rate * g, None
+            mom = momentum * mom + g
+            return flat - learning_rate * mom, mom
+
+        self._fwd_compress = fwd_compress
+        self._apply = apply_sgd
+
+    def warmup(self, X, y) -> None:
+        """Trace+compile both device steps WITHOUT running a kv round
+        (results discarded, trainer state untouched) — lets callers
+        serialize expensive first compiles without holding up the FSA
+        barrier."""
+        import jax
+
+        packed, _u, _v = self._fwd_compress(self._flat, self._u,
+                                            self._v, X, y)
+        up = jax.device_put(np.zeros(2 * self._up_cap, np.float32))
+        flat2, _mom2 = self._apply(self._flat, self._mom, up)
+        jax.block_until_ready((packed, flat2))
+
+    # -- one round -------------------------------------------------------
+
+    def step(self, X, y) -> float:
+        """One FSA round: device grad+compress, HiPS aggregate, device
+        sparse apply. Returns the loss (device-computed, host float)."""
+        import jax
+
+        packed_d, self._u, self._v = self._fwd_compress(
+            self._flat, self._u, self._v, X, y)
+        # ONE compact device->host transfer (1 + 2k floats vs total)
+        packed = np.asarray(packed_d)
+        loss = float(packed[0])
+        vals = packed[1:1 + self.k]
+        idx = packed[1 + self.k:].astype(np.int64)
+        agg = self._aggregate_sparse(vals, idx)
+        ups, upi = self._nonzeros(agg)
+        # ONE compact FIXED-SIZE host->device transfer; apply locally
+        # (cnn_bsc worker-side optimizer semantics). Pad slot: index 0
+        # with value 0 — a scatter-add no-op.
+        up = np.zeros(2 * self._up_cap, np.float32)
+        n = len(ups)
+        up[:n] = ups
+        up[self._up_cap:self._up_cap + n] = upi.astype(np.float32)
+        self._flat, self._mom = self._apply(
+            self._flat, self._mom, jax.device_put(up))
+        return loss
+
+    # -- host-side kv round ----------------------------------------------
+
+    def _aggregate_sparse(self, vals: np.ndarray, idx: np.ndarray
+                          ) -> List[np.ndarray]:
+        """Scatter the compact selection into per-key dense buffers,
+        run the push/pull round, return per-key aggregated grads."""
+        outs: List[np.ndarray] = []
+        for i, (off, sz) in enumerate(zip(self._offsets[:-1], self._sizes)):
+            sel = (idx >= off) & (idx < off + sz)
+            dense = np.zeros(sz, np.float32)
+            dense[idx[sel] - off] = vals[sel]
+            key = self.begin_key + i
+            self.kv.push(key, dense.reshape(self._shapes[i]), priority=-i)
+            out = np.zeros(self._shapes[i], np.float32)
+            self.kv.pull(key, out=out, priority=-i)
+            outs.append(out)
+        self.kv.wait()
+        return outs
+
+    def _nonzeros(self, outs: List[np.ndarray]
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+        vals, idxs = [], []
+        for i, (off, out) in enumerate(zip(self._offsets[:-1], outs)):
+            flat = out.ravel()
+            nz = np.nonzero(flat)[0]
+            vals.append(flat[nz].astype(np.float32))
+            idxs.append((nz + off).astype(np.int32))
+        return np.concatenate(vals), np.concatenate(idxs)
+
+    # -- escape hatch ----------------------------------------------------
+
+    @property
+    def leaves(self) -> List[np.ndarray]:
+        """Materialize current params on host (ONE transfer) — for eval
+        or checkpointing, not the training loop."""
+        flat = np.asarray(self._flat)
+        return [flat[o:o + s].reshape(sh) for o, s, sh in
+                zip(self._offsets[:-1], self._sizes, self._shapes)]
