@@ -1,0 +1,110 @@
+"""Initializer library (reference: python/mxnet/initializer.py)."""
+
+import numpy as np
+import pytest
+
+from geomx_tpu import initializer as init_mod
+from geomx_tpu.initializer import (
+    Bilinear, Constant, LSTMBias, Mixed, MSRAPrelu, Normal, One,
+    Orthogonal, Uniform, Xavier, Zero, as_flax, create,
+)
+
+
+def test_name_dispatch_bias_gamma_beta():
+    x = Xavier(seed=0)
+    b = x.init((8,), name="fc1_bias")
+    np.testing.assert_array_equal(b, 0.0)
+    g = x.init((8,), name="bn0_gamma")
+    np.testing.assert_array_equal(g, 1.0)
+    var = x.init((8,), name="bn0_moving_var")
+    np.testing.assert_array_equal(var, 1.0)
+
+
+def test_zero_one_constant():
+    assert float(Zero().init((3,)).sum()) == 0.0
+    assert float(One().init((3,)).sum()) == 3.0
+    np.testing.assert_array_equal(Constant(2.5).init((2, 2)), 2.5)
+
+
+def test_uniform_normal_ranges():
+    u = Uniform(scale=0.1, seed=1).init((1000,))
+    assert float(np.max(np.abs(u))) <= 0.1
+    n = Normal(sigma=0.5, seed=1).init((20000,))
+    assert abs(float(np.std(n)) - 0.5) < 0.02
+
+
+def test_orthogonal_rows_orthonormal():
+    w = Orthogonal(scale=1.0, seed=2).init((16, 64))
+    gram = w @ w.T
+    np.testing.assert_allclose(gram, np.eye(16), atol=1e-5)
+
+
+@pytest.mark.parametrize("factor_type,expect_fan", [
+    ("in", 6 * 9), ("out", 4 * 9), ("avg", (6 * 9 + 4 * 9) / 2)])
+def test_xavier_scale_follows_factor(factor_type, expect_fan):
+    # conv kernel [out=4, in=6, 3, 3] — mxnet layout conventions
+    x = Xavier(rnd_type="uniform", factor_type=factor_type,
+               magnitude=3.0, seed=3)
+    w = x.init((4, 6, 3, 3))
+    bound = np.sqrt(3.0 / expect_fan)
+    assert float(np.max(np.abs(w))) <= bound + 1e-7
+    assert float(np.max(np.abs(w))) > bound * 0.8  # actually fills range
+
+
+def test_xavier_rejects_vectors():
+    with pytest.raises(ValueError, match="2D"):
+        Xavier().init((8,), name="w_weight")
+
+
+def test_msraprelu_magnitude():
+    m = MSRAPrelu(slope=0.0, seed=4)
+    assert m.rnd_type == "gaussian"
+    assert abs(m.magnitude - 2.0) < 1e-12
+    w = m.init((64, 64))
+    assert abs(float(np.std(w)) - np.sqrt(2.0 / 64)) < 0.01
+
+
+def test_bilinear_upsampling_kernel():
+    w = Bilinear().init((1, 1, 4, 4))
+    # symmetric, peak in the center block, matches the classic kernel
+    np.testing.assert_allclose(w[0, 0], w[0, 0][::-1, ::-1], atol=1e-6)
+    assert abs(float(w[0, 0, 1, 1]) - 0.5625) < 1e-6
+
+
+def test_lstm_bias_forget_gate():
+    # normal name dispatch must reach the forget-gate logic (the class
+    # overrides the bias hook; a plain initializer still zeros biases)
+    arr = LSTMBias(forget_bias=1.0).init((16,), name="lstm_i2h_bias")
+    np.testing.assert_array_equal(arr[4:8], 1.0)
+    assert float(np.abs(arr[:4]).sum()) == 0.0
+    assert float(np.abs(arr[8:]).sum()) == 0.0
+    plain = Xavier().init((16,), name="lstm_i2h_bias")
+    np.testing.assert_array_equal(plain, 0.0)
+
+
+def test_mixed_pattern_dispatch():
+    mix = Mixed([".*fancy.*", ".*"], [Constant(7.0), Zero()])
+    a = np.empty((2,), np.float32)
+    mix("my_fancy_weight", a)
+    np.testing.assert_array_equal(a, 7.0)
+    mix("other_weight", a)
+    np.testing.assert_array_equal(a, 0.0)
+
+
+def test_create_factory():
+    assert isinstance(create("xavier"), Xavier)
+    assert create(Uniform(0.2)).scale == 0.2
+    with pytest.raises(ValueError):
+        create("nope")
+
+
+def test_as_flax_adapter():
+    import jax
+
+    fn = as_flax("xavier")
+    w = fn(jax.random.PRNGKey(0), (8, 8))
+    w2 = fn(jax.random.PRNGKey(0), (8, 8))
+    w3 = fn(jax.random.PRNGKey(1), (8, 8))
+    np.testing.assert_array_equal(np.asarray(w), np.asarray(w2))
+    assert not np.array_equal(np.asarray(w), np.asarray(w3))
+    assert w.shape == (8, 8)
