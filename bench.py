@@ -420,18 +420,21 @@ def bench_hips_hfa(hfa_k1: int = 4, hfa_k2: int = 2):
         topo.stop()
 
 
-def bench_transformer_mfu(attn_impl: str = "dense"):
+def bench_transformer_mfu(attn_impl: str = "dense", T: int = 512,
+                          B: int = 16):
     """Single-chip transformer train step -> MFU.
 
     ``attn_impl``: "dense" (XLA einsum) or "flash" (the Pallas
-    FlashAttention-2 kernels in geomx_tpu.ops.flash_attention)."""
+    FlashAttention-2 kernels in geomx_tpu.ops.flash_attention).
+    ``T``/``B``: sequence length / batch (the long-context variant runs
+    T=2048 at constant tokens-per-step)."""
     import jax
     import jax.numpy as jnp
     import optax
 
     from geomx_tpu.models.transformer import Transformer, make_attention
 
-    B, T, D, L, H = 16, 512, 512, 8, 8
+    D, L, H = 512, 8, 8
     attn_fn = make_attention(attn_impl) if attn_impl != "dense" else None
     model = Transformer(vocab=32768, dim=D, depth=L, heads=H, max_len=T,
                         attn_fn=attn_fn, compute_dtype=jnp.bfloat16)
@@ -478,6 +481,7 @@ def bench_transformer_mfu(attn_impl: str = "dense"):
         "tflops_s": round(flops_s / 1e12, 2),
         "mfu": round(flops_s / peak, 4) if peak else None,
         "attn": attn_impl,
+        "seq_len": T,
         "device": __import__("jax").devices()[0].device_kind,
     }
 
@@ -554,6 +558,14 @@ def main():
             details["transformer_flash"] = bench_transformer_mfu("flash")
         except Exception as e:  # noqa: BLE001 — secondary metric
             details["transformer_flash"] = {"error": str(e)}
+        # long-context variant (constant tokens/step): where flash's
+        # O(block^2) on-chip memory pays off vs the dense T^2 scores
+        for key, impl in (("transformer_long_dense", "dense"),
+                          ("transformer_long_flash", "flash")):
+            try:
+                details[key] = bench_transformer_mfu(impl, T=2048, B=4)
+            except Exception as e:  # noqa: BLE001 — secondary metric
+                details[key] = {"error": str(e)}
 
     if jax.default_backend() != "cpu":
         # context for the judge: in this harness the chip is reached via

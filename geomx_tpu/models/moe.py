@@ -29,7 +29,16 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["MoEBlock", "moe_param_sharding"]
+__all__ = ["MoEBlock", "moe_param_sharding", "is_expert_param"]
+
+# leaf names of expert-stacked params (leading axis = expert dim)
+EXPERT_PARAM_NAMES = ("w_up", "b_up", "w_dn", "b_dn")
+
+
+def is_expert_param(path: str) -> bool:
+    """True when a '/'-joined param path names an expert-stacked leaf
+    (the single source of truth for ep-sharding rules)."""
+    return path.rsplit("/", 1)[-1] in EXPERT_PARAM_NAMES
 
 
 class MoEBlock(nn.Module):
@@ -95,8 +104,7 @@ def moe_param_sharding(mesh: Mesh):
     def shard(params):
         def put(path_entries, leaf):
             path = "/".join(str(getattr(p, "key", p)) for p in path_entries)
-            if any(path.endswith(s) for s in
-                   ("w_up", "b_up", "w_dn", "b_dn")):
+            if is_expert_param(path):
                 spec = P(*(["ep"] + [None] * (leaf.ndim - 1)))
             else:
                 spec = P()

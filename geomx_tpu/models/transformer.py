@@ -77,6 +77,7 @@ class Block(nn.Module):
     heads: int
     mlp_ratio: int = 4
     attn_fn: Optional[Callable] = None
+    moe_experts: int = 0        # > 0: MoE FFN over the "ep" axis
     compute_dtype: Any = jnp.float32
 
     @nn.compact
@@ -91,6 +92,12 @@ class Block(nn.Module):
         o = attn(q.reshape(shp), k.reshape(shp), v.reshape(shp))
         o = o.reshape(x.shape[0], x.shape[1], self.dim)
         x = x + nn.Dense(self.dim, dtype=dt, name="proj")(o)
+        if self.moe_experts:
+            from geomx_tpu.models.moe import MoEBlock
+
+            return MoEBlock(self.dim, num_experts=self.moe_experts,
+                            mlp_ratio=self.mlp_ratio, compute_dtype=dt,
+                            name="moe")(x)
         h = nn.LayerNorm(dtype=dt, name="ln2")(x)
         h = nn.Dense(self.mlp_ratio * self.dim, dtype=dt, name="up")(h)
         h = nn.gelu(h)
@@ -105,6 +112,7 @@ class Transformer(nn.Module):
     heads: int = 4
     max_len: int = 2048
     attn_fn: Optional[Callable] = None
+    moe_experts: int = 0        # > 0: every block's FFN is a top-1 MoE
     compute_dtype: Any = jnp.float32
 
     @nn.compact
@@ -117,6 +125,7 @@ class Transformer(nn.Module):
         x = x + pos
         for i in range(self.depth):
             x = Block(self.dim, self.heads, attn_fn=self.attn_fn,
+                      moe_experts=self.moe_experts,
                       compute_dtype=dt, name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=dt, name="lnf")(x)
         return nn.Dense(self.vocab, dtype=dt, name="head")(x).astype(
@@ -124,9 +133,15 @@ class Transformer(nn.Module):
 
 
 def transformer_param_sharding(mesh: Mesh):
-    """Megatron-style PartitionSpec rules by parameter path suffix."""
+    """Megatron-style PartitionSpec rules by parameter path suffix
+    (plus expert sharding over "ep" for MoE blocks when present)."""
+    has_ep = "ep" in mesh.axis_names
 
-    def spec_for(path: str) -> P:
+    def spec_for(path: str, ndim: int = 2) -> P:
+        from geomx_tpu.models.moe import is_expert_param
+
+        if has_ep and is_expert_param(path):
+            return P(*(["ep"] + [None] * (ndim - 1)))
         if path.endswith("qkv/kernel") or path.endswith("up/kernel"):
             return P(None, "tp")
         if path.endswith("qkv/bias") or path.endswith("up/bias"):
@@ -139,7 +154,7 @@ def transformer_param_sharding(mesh: Mesh):
         def put(path_entries, leaf):
             path = "/".join(str(getattr(p, "key", p)) for p in path_entries)
             return jax.device_put(
-                leaf, NamedSharding(mesh, spec_for(path)))
+                leaf, NamedSharding(mesh, spec_for(path, leaf.ndim)))
 
         return jax.tree_util.tree_map_with_path(put, params)
 

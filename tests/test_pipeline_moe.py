@@ -144,3 +144,32 @@ def test_moe_gradients_flow_to_experts():
         g = jax.jit(jax.grad(loss))(sharded)
     gw = g["params"]["w_up"]
     assert float(jnp.max(jnp.abs(gw))) > 0.0
+
+
+def test_moe_transformer_trains_on_dp_ep_mesh():
+    """Transformer(moe_experts=N) + transformer_param_sharding over a
+    dp x ep mesh: one jitted grad step runs and the MoE expert grads
+    are sharded over ep."""
+    from geomx_tpu.models.transformer import (
+        Transformer, transformer_param_sharding)
+
+    mesh = make_mesh(jax.devices(), ep=2)
+    model = Transformer(vocab=64, dim=16, depth=1, heads=2, max_len=16,
+                        moe_experts=2)
+    tok = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0, 64)
+    with mesh:
+        params = model.init(jax.random.PRNGKey(1), tok)["params"]
+        params = transformer_param_sharding(mesh)(params)
+
+        def loss_fn(p):
+            logits, _ = model.apply({"params": p}, tok,
+                                    mutable=["losses"])
+            return jnp.mean(logits ** 2)
+
+        loss, g = jax.jit(jax.value_and_grad(loss_fn))(params)
+    assert np.isfinite(float(loss))
+    w_up = params["block0"]["moe"]["w_up"]
+    assert "ep" in str(w_up.sharding.spec)
+    g_up = g["block0"]["moe"]["w_up"]
+    assert g_up.shape == w_up.shape
+    assert "ep" in str(g_up.sharding.spec)
