@@ -37,6 +37,10 @@ def main():
     ap.add_argument("--sp", type=int, default=1)
     ap.add_argument("-lr", "--learning-rate", type=float, default=3e-4)
     ap.add_argument("--max-iters", type=int, default=20)
+    ap.add_argument("--ckpt-dir", type=str, default="",
+                    help="sharded-checkpoint dir; resumes from the "
+                         "latest step when one exists")
+    ap.add_argument("--ckpt-every", type=int, default=10)
     ap.add_argument("-c", "--cpu", action="store_true")
     args = ap.parse_args()
 
@@ -98,11 +102,29 @@ def main():
             updates, s = opt.update(grads, s, p)
             return optax.apply_updates(p, updates), s, loss
 
+        start_it = 1
+        if args.ckpt_dir:
+            from geomx_tpu.checkpoint_sharded import (
+                latest_step, restore_sharded, save_sharded)
+
+            last = latest_step(args.ckpt_dir)
+            if last is not None:
+                state = restore_sharded(
+                    args.ckpt_dir, last,
+                    {"params": params, "opt_state": opt_state})
+                params, opt_state = state["params"], state["opt_state"]
+                start_it = last + 1
+                print(f"resumed from step {last}", flush=True)
+
         t0 = time.time()
-        for it in range(1, args.max_iters + 1):
+        for it in range(start_it, args.max_iters + 1):
             params, opt_state, loss = step(params, opt_state, tokens)
             print(f"[Time {time.time() - t0:.3f}][Iteration {it}] "
                   f"Loss {float(loss):.4f}", flush=True)
+            if args.ckpt_dir and it % args.ckpt_every == 0:
+                save_sharded(args.ckpt_dir, it,
+                             {"params": params, "opt_state": opt_state})
+                print(f"checkpointed step {it}", flush=True)
 
 
 if __name__ == "__main__":

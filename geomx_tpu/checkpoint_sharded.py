@@ -1,0 +1,94 @@
+"""Sharded (mesh) checkpointing via orbax.
+
+The PS path persists host-side numpy state (``geomx_tpu.checkpoint``,
+reference: python/mxnet/model.py:383 save_checkpoint). The MESH path —
+dp/tp/sp/pp/ep-sharded training state on a device mesh — needs a
+distributed story the reference never had: every host writes only its
+own shards, restore re-lays arrays out onto the (possibly different)
+target mesh. That is orbax's job; this module is the thin, opinionated
+wrapper:
+
+- ``save_sharded(path, step, tree)``: synchronous atomic write of a
+  pytree of (sharded) jax arrays under ``path/step`` (async
+  checkpointing is deliberately off: the PS-side checkpoint cadence is
+  epoch-scale, and synchronous saves keep the crash story trivial);
+- ``restore_sharded(path, step, template)``: restore onto the shardings
+  of ``template`` (an abstract or concrete pytree) — moving a
+  checkpoint between mesh shapes is re-annotating the template;
+- ``latest_step(path)``: resume discovery.
+
+Works on the virtual CPU mesh in tests exactly as on a pod.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+__all__ = ["save_sharded", "restore_sharded", "latest_step"]
+
+
+def _manager(path: str, create: bool = True):
+    import orbax.checkpoint as ocp
+
+    return ocp.CheckpointManager(
+        os.path.abspath(path),
+        options=ocp.CheckpointManagerOptions(create=create,
+                                             enable_async_checkpointing=False),
+    )
+
+
+def save_sharded(path: str, step: int, tree: Any) -> None:
+    """Write ``tree`` (pytree of jax arrays, sharded or not) as
+    checkpoint ``step`` under ``path``. Blocks until durable (atomic
+    finalize — a crashed write never looks like a checkpoint)."""
+    import orbax.checkpoint as ocp
+
+    mgr = _manager(path)
+    try:
+        mgr.save(step, args=ocp.args.StandardSave(tree))
+        mgr.wait_until_finished()
+    finally:
+        mgr.close()
+
+
+def restore_sharded(path: str, step: Optional[int], template: Any) -> Any:
+    """Restore checkpoint ``step`` (or the latest when None) onto the
+    shardings/dtypes of ``template`` — pass a pytree of arrays laid out
+    on the TARGET mesh (values are ignored, structure/sharding used)."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    mgr = _manager(path, create=False)
+    try:
+        if step is None:
+            step = mgr.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints under {path}")
+        from jax.sharding import NamedSharding
+
+        def to_abstract(x):
+            # propagate only mesh-aware layouts; leaves that were never
+            # explicitly sharded (optimizer scalars etc.) restore
+            # UNCOMMITTED so jit may re-place them freely — a restored
+            # SingleDeviceSharding would pin them and clash with
+            # mesh-sharded arguments in the same jitted call
+            sh = getattr(x, "sharding", None)
+            sh = sh if isinstance(sh, NamedSharding) else None
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+
+        abstract = jax.tree_util.tree_map(to_abstract, template)
+        return mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+    finally:
+        mgr.close()
+
+
+def latest_step(path: str) -> Optional[int]:
+    """Newest step number under ``path`` (None when empty/missing)."""
+    if not os.path.isdir(path):
+        return None
+    mgr = _manager(path, create=False)
+    try:
+        return mgr.latest_step()
+    finally:
+        mgr.close()

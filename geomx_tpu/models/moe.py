@@ -41,6 +41,12 @@ def is_expert_param(path: str) -> bool:
     return path.rsplit("/", 1)[-1] in EXPERT_PARAM_NAMES
 
 
+def expert_spec(ndim: int) -> P:
+    """PartitionSpec for an expert-stacked leaf: experts over "ep",
+    everything else replicated."""
+    return P(*(["ep"] + [None] * (ndim - 1)))
+
+
 class MoEBlock(nn.Module):
     """Drop-in FFN block: LayerNorm -> top-1 MoE MLP -> residual."""
 
@@ -104,10 +110,7 @@ def moe_param_sharding(mesh: Mesh):
     def shard(params):
         def put(path_entries, leaf):
             path = "/".join(str(getattr(p, "key", p)) for p in path_entries)
-            if is_expert_param(path):
-                spec = P(*(["ep"] + [None] * (leaf.ndim - 1)))
-            else:
-                spec = P()
+            spec = expert_spec(leaf.ndim) if is_expert_param(path) else P()
             return jax.device_put(leaf, NamedSharding(mesh, spec))
 
         return jax.tree_util.tree_map_with_path(put, params)

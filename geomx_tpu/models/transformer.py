@@ -138,10 +138,10 @@ def transformer_param_sharding(mesh: Mesh):
     has_ep = "ep" in mesh.axis_names
 
     def spec_for(path: str, ndim: int = 2) -> P:
-        from geomx_tpu.models.moe import is_expert_param
+        from geomx_tpu.models.moe import expert_spec, is_expert_param
 
         if has_ep and is_expert_param(path):
-            return P(*(["ep"] + [None] * (ndim - 1)))
+            return expert_spec(ndim)
         if path.endswith("qkv/kernel") or path.endswith("up/kernel"):
             return P(None, "tp")
         if path.endswith("qkv/bias") or path.endswith("up/bias"):

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Flash vs dense attention sweep on the local accelerator.
+
+Prints a JSON line per (T, D, causal) config with forward and
+forward+backward wall times for the XLA dense einsum and the Pallas
+FlashAttention-2 kernels (geomx_tpu.ops.flash_attention). Run on TPU;
+on CPU the flash path is interpret-mode (correctness only) and is
+skipped unless --force-cpu.
+
+    python tools/attention_bench.py --seqs 512,1024,2048,4096
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _time(fn, *args, iters=20):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", type=str, default="512,1024,2048,4096")
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--head-dim", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--force-cpu", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from geomx_tpu.models.transformer import dense_attention
+    from geomx_tpu.ops.flash_attention import flash_attention
+
+    if jax.default_backend() != "tpu" and not args.force_cpu:
+        print("not on TPU (flash would run interpret-mode); "
+              "--force-cpu to override", file=sys.stderr)
+        return
+
+    B, H, D = args.batch, args.heads, args.head_dim
+    for T in [int(s) for s in args.seqs.split(",")]:
+        q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (B, T, H, D),
+                                     jnp.bfloat16) for i in range(3))
+
+        dense_f = jax.jit(lambda q, k, v: dense_attention(q, k, v))
+        flash_f = jax.jit(lambda q, k, v: flash_attention(q, k, v))
+        dense_g = jax.jit(jax.grad(
+            lambda q, k, v: dense_attention(q, k, v).astype(
+                jnp.float32).sum(), argnums=(0, 1, 2)))
+        flash_g = jax.jit(jax.grad(
+            lambda q, k, v: flash_attention(q, k, v).astype(
+                jnp.float32).sum(), argnums=(0, 1, 2)))
+
+        row = {"T": T, "B": B, "H": H, "D": D, "causal": True,
+               "dense_fwd_ms": round(_time(dense_f, q, k, v), 3),
+               "flash_fwd_ms": round(_time(flash_f, q, k, v), 3),
+               "dense_fwdbwd_ms": round(_time(dense_g, q, k, v), 3),
+               "flash_fwdbwd_ms": round(_time(flash_g, q, k, v), 3)}
+        row["fwd_speedup"] = round(
+            row["dense_fwd_ms"] / row["flash_fwd_ms"], 2)
+        row["fwdbwd_speedup"] = round(
+            row["dense_fwdbwd_ms"] / row["flash_fwdbwd_ms"], 2)
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()
