@@ -1,0 +1,66 @@
+"""Recurrent layers: LSTM / GRU / vanilla RNN (reference: src/operator/
+rnn.cc + python/mxnet/gluon/rnn — the reference op set ships fused RNN
+ops; here recurrence is ``flax.linen.scan`` over optimized cells, which
+XLA compiles to a fused loop on TPU).
+
+``RNNModel`` is a small recurrent language model used by the tests and
+available from the zoo factory via ``get_model("lstm_lm", ...)``-style
+names (lstm_lm, gru_lm, rnn_lm).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["RNNLayer", "RNNModel"]
+
+_CELLS = {
+    "lstm": nn.OptimizedLSTMCell,
+    "gru": nn.GRUCell,
+    "rnn": nn.SimpleCell,
+}
+
+
+class RNNLayer(nn.Module):
+    """One recurrent layer scanned over time: [B, T, F] -> [B, T, H]."""
+
+    hidden: int
+    cell_type: str = "lstm"
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if self.cell_type not in _CELLS:
+            raise ValueError(f"cell_type must be one of {sorted(_CELLS)}")
+        # the recurrence runs in f32 regardless of compute_dtype: the
+        # scan carry must keep one dtype end-to-end and accumulated
+        # cell state degrades fast in bf16; embed/head still honor
+        # compute_dtype (nn.RNN scans the cell and owns carry init)
+        cell = _CELLS[self.cell_type](features=self.hidden)
+        return nn.RNN(cell)(x.astype(jnp.float32)).astype(
+            self.compute_dtype)
+
+
+class RNNModel(nn.Module):
+    """Recurrent LM: embed -> N recurrent layers -> vocab head."""
+
+    vocab: int = 256
+    hidden: int = 128
+    depth: int = 1
+    cell_type: str = "lstm"
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens):
+        dt = self.compute_dtype
+        x = nn.Embed(self.vocab, self.hidden, dtype=dt,
+                     name="embed")(tokens)
+        for i in range(self.depth):
+            x = RNNLayer(self.hidden, self.cell_type, compute_dtype=dt,
+                         name=f"layer{i}")(x)
+        return nn.Dense(self.vocab, dtype=dt,
+                        name="head")(x).astype(jnp.float32)

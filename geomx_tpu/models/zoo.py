@@ -397,6 +397,11 @@ def get_model(name: str, num_classes: int = 1000,
                         block_config=cfg, **common, **kwargs)
     if name == "inceptionv3":
         return InceptionV3(**common, **kwargs)
+    if name.endswith("_lm") and name[:-3] in ("lstm", "gru", "rnn"):
+        from geomx_tpu.models.rnn import RNNModel
+
+        return RNNModel(vocab=num_classes, cell_type=name[:-3],
+                        compute_dtype=compute_dtype, **kwargs)
     if name.startswith("resnet"):
         from geomx_tpu.models.resnet import create_resnet
 
