@@ -41,6 +41,10 @@ def main():
                     help="sharded-checkpoint dir; resumes from the "
                          "latest step when one exists")
     ap.add_argument("--ckpt-every", type=int, default=10)
+    ap.add_argument("--microbatches", type=int, default=1,
+                    help="gradient-accumulation microbatches per step")
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize transformer blocks")
     ap.add_argument("-c", "--cpu", action="store_true")
     args = ap.parse_args()
 
@@ -72,7 +76,8 @@ def main():
             else make_attention("auto", mesh=mesh))
     model = Transformer(vocab=args.vocab, dim=args.dim, depth=args.depth,
                         heads=args.heads, max_len=args.seq_len,
-                        attn_fn=attn, compute_dtype=jnp.bfloat16)
+                        attn_fn=attn, remat=args.remat,
+                        compute_dtype=jnp.bfloat16)
 
     rng = np.random.RandomState(0)
     # synthetic copy-task-ish stream: next token = current + 1 mod vocab,
@@ -96,9 +101,16 @@ def main():
             return optax.softmax_cross_entropy_with_integer_labels(
                 logits, tgt).mean()
 
+        grad_fn = lambda p, toks: jax.value_and_grad(  # noqa: E731
+            loss_fn)(p, toks)
+        if args.microbatches != 1:
+            from geomx_tpu.parallel.grad_accum import accumulate_gradients
+
+            grad_fn = accumulate_gradients(grad_fn, args.microbatches)
+
         @jax.jit
         def step(p, s, toks):
-            loss, grads = jax.value_and_grad(loss_fn)(p, toks)
+            loss, grads = grad_fn(p, toks)
             updates, s = opt.update(grads, s, p)
             return optax.apply_updates(p, updates), s, loss
 

@@ -113,6 +113,7 @@ class Transformer(nn.Module):
     max_len: int = 2048
     attn_fn: Optional[Callable] = None
     moe_experts: int = 0        # > 0: every block's FFN is a top-1 MoE
+    remat: bool = False         # rematerialize blocks (activation ckpt)
     compute_dtype: Any = jnp.float32
 
     @nn.compact
@@ -123,10 +124,14 @@ class Transformer(nn.Module):
         pos = nn.Embed(self.max_len, self.dim, dtype=dt, name="pos")(
             jnp.arange(tokens.shape[1])[None, :])
         x = x + pos
+        # remat trades FLOPs for HBM: block activations are recomputed
+        # in the backward pass instead of stored — the standard lever
+        # for long sequences (jax.checkpoint under the hood)
+        block_cls = nn.remat(Block) if self.remat else Block
         for i in range(self.depth):
-            x = Block(self.dim, self.heads, attn_fn=self.attn_fn,
-                      moe_experts=self.moe_experts,
-                      compute_dtype=dt, name=f"block{i}")(x)
+            x = block_cls(self.dim, self.heads, attn_fn=self.attn_fn,
+                          moe_experts=self.moe_experts,
+                          compute_dtype=dt, name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=dt, name="lnf")(x)
         return nn.Dense(self.vocab, dtype=dt, name="head")(x).astype(
             jnp.float32)
