@@ -486,15 +486,49 @@ def bench_transformer_mfu(attn_impl: str = "dense", T: int = 512,
     }
 
 
+def _device_alive(timeout_s: float = 180.0) -> bool:
+    """Probe the accelerator IN A SUBPROCESS: a wedged tunnel hangs any
+    in-process jax call forever, which would leave the driver with no
+    JSON at all."""
+    import subprocess
+    import sys
+
+    code = ("import jax, numpy as np; "
+            "x = jax.device_put(np.ones(8, 'f4')); "
+            "jax.block_until_ready(x); "
+            "print(jax.devices()[0].device_kind)")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             timeout=timeout_s, capture_output=True)
+        # returncode alone is not enough: a failed plugin init can fall
+        # back to CPU inside the child and still exit 0 — require the
+        # probe to actually land on a TPU
+        return (out.returncode == 0
+                and b"tpu" in out.stdout.strip().lower())
+    except subprocess.TimeoutExpired:
+        return False
+
+
+_DEVICE_FALLBACK = False
+
+
 def _setup_jax():
     """Persistent compile cache (tunnel compiles cost ~150s each; cache
     them across bench runs) + optional platform override for local runs
-    (GEOMX_BENCH_PLATFORM=cpu — the axon plugin ignores JAX_PLATFORMS)."""
+    (GEOMX_BENCH_PLATFORM=cpu — the axon plugin ignores JAX_PLATFORMS).
+    If the accelerator is unreachable (dead tunnel), fall back to CPU
+    so the bench still emits its JSON line (clearly labeled)."""
+    global _DEVICE_FALLBACK
+
     import jax
 
     plat = os.environ.get("GEOMX_BENCH_PLATFORM")
     if plat:
         jax.config.update("jax_platforms", plat)
+    elif not _device_alive():
+        _phase("accelerator unreachable -> CPU fallback")
+        jax.config.update("jax_platforms", "cpu")
+        _DEVICE_FALLBACK = True
     try:
         jax.config.update("jax_compilation_cache_dir",
                           os.path.join(os.path.dirname(
@@ -548,26 +582,32 @@ def main():
     # fixed keys so the schema is stable run-to-run: "transformer" is
     # ALWAYS the dense-attention result; the Pallas flash path (chip
     # only — interpret mode on CPU is test-grade, not perf-grade) is
-    # always "transformer_flash"
-    try:
-        details["transformer"] = bench_transformer_mfu("dense")
-    except Exception as e:  # noqa: BLE001 — secondary metric
-        details["transformer"] = {"error": str(e)}
-    if jax.default_backend() == "tpu":
-        try:
-            details["transformer_flash"] = bench_transformer_mfu("flash")
-        except Exception as e:  # noqa: BLE001 — secondary metric
-            details["transformer_flash"] = {"error": str(e)}
-        # long-context variant (constant tokens/step): where flash's
+    # always "transformer_flash". MFU phases are chip-only: a 59M-param
+    # train step on CPU takes tens of minutes and the number would be
+    # meaningless.
+    tf_keys = ("transformer", "transformer_flash",
+               "transformer_long_dense", "transformer_long_flash")
+    if jax.default_backend() != "tpu":
+        for key in tf_keys:  # stable schema on every backend
+            details[key] = {"skipped": "non-TPU backend"}
+    else:
+        # long-context variant runs constant tokens/step: where flash's
         # O(block^2) on-chip memory pays off vs the dense T^2 scores
-        for key, impl in (("transformer_long_dense", "dense"),
-                          ("transformer_long_flash", "flash")):
+        configs = {"transformer": ("dense", 512, 16),
+                   "transformer_flash": ("flash", 512, 16),
+                   "transformer_long_dense": ("dense", 2048, 4),
+                   "transformer_long_flash": ("flash", 2048, 4)}
+        for key in tf_keys:
+            impl, T, B = configs[key]
             try:
-                details[key] = bench_transformer_mfu(impl, T=2048, B=4)
+                details[key] = bench_transformer_mfu(impl, T=T, B=B)
             except Exception as e:  # noqa: BLE001 — secondary metric
                 details[key] = {"error": str(e)}
 
-    if jax.default_backend() != "cpu":
+    if _DEVICE_FALLBACK:
+        details["env_note"] = ("TPU tunnel unreachable at bench time; "
+                               "numbers are CPU-fallback, not chip")
+    elif jax.default_backend() != "cpu":
         # context for the judge: in this harness the chip is reached via
         # a network tunnel, so every host<->device transfer pays WAN-ish
         # latency; the PS data path does 2 batched transfers per round,

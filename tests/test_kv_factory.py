@@ -1,0 +1,39 @@
+"""KVStore factory type strings (reference: kvstore.cc:41-82 Create)."""
+
+import pytest
+
+import geomx_tpu.kvstore as kvmod
+from geomx_tpu.kvstore.local import KVStoreLocal
+
+
+def test_local_default():
+    assert isinstance(kvmod.create(), KVStoreLocal)
+    assert isinstance(kvmod.create("local"), KVStoreLocal)
+
+
+@pytest.mark.parametrize("name,expect_sync", [
+    ("dist", True),
+    ("dist_sync", True),
+    ("dist_sync_tpu", True),      # the driver's target config string
+    ("dist_sync_device", True),
+    ("dist_async", False),        # MixedSync: async global tier
+])
+def test_dist_aliases_map_to_sync_mode(monkeypatch, name, expect_sync):
+    import geomx_tpu.kvstore.dist as dist_mod
+
+    captured = {}
+
+    class FakeDist:
+        def __init__(self, sync_global):
+            captured["sync_global"] = sync_global
+
+    monkeypatch.setattr(dist_mod, "KVStoreDist", FakeDist)
+    kvmod.create(name)
+    assert captured["sync_global"] is expect_sync
+
+
+def test_nccl_store_type():
+    from geomx_tpu.kvstore.device import KVStoreDeviceAllreduce
+
+    kv = kvmod.create("nccl")
+    assert isinstance(kv, KVStoreDeviceAllreduce)
