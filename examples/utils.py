@@ -29,13 +29,14 @@ def build_model_and_step(batch_size: int, compute_dtype=jnp.float32,
     (the reference pushes grad/num_samples, examples/cnn.py:123 — MXNet
     grads are per-batch sums; JAX mean-loss grads are already normalized).
 
-    ``model``: "cnn" (the reference demo net) or a resnet name
-    ("resnet18", "resnet50", ...). ResNet BatchNorm running stats stay
+    ``model``: "cnn" (the reference demo net) or any
+    ``geomx_tpu.models.get_model`` zoo name ("resnet18", "mobilenet1.0",
+    "vgg11", "densenet121", ...). BatchNorm running stats stay
     WORKER-LOCAL (not pushed through the kvstore) — the reference's
     kvstore flow treats BN aux states the same way: only optimizer-
     updated parameters travel.
 
-    Contract note: the resnet-path grad_step/eval_step close over a
+    Contract note: the zoo-path grad_step/eval_step close over a
     mutable batch_stats box, so unlike the cnn path they are STATEFUL —
     do not wrap them in an outer jax.jit and do not share one instance
     across concurrent workers; call build_model_and_step per worker.
@@ -65,48 +66,69 @@ def build_model_and_step(batch_size: int, compute_dtype=jnp.float32,
             pred = jnp.argmax(net.apply(p, X), axis=-1)
             return jnp.mean((pred == y).astype(jnp.float32))
 
-    elif model.startswith("resnet"):
-        from geomx_tpu.models import create_resnet
+    else:
+        from geomx_tpu.models import get_model
 
-        net = create_resnet(model, num_classes=num_classes,
-                            compute_dtype=compute_dtype)
+        # small_images: cifar/mnist-sized stem for the resnet family
+        # (forwarded through the zoo factory; other families size by
+        # their conv/pool stacks alone)
+        extra = {"small_images": True} if model.startswith("resnet") \
+            else {}
+        net = get_model(model, num_classes=num_classes,
+                        compute_dtype=compute_dtype, **extra)
         variables = net.init(rng, jnp.zeros((1, *input_shape), jnp.float32))
         leaves, treedef = jax.tree_util.tree_flatten(variables["params"])
-        state_box = {"batch_stats": variables["batch_stats"]}
+        has_bn = "batch_stats" in variables
+        state_box = {"batch_stats": variables.get("batch_stats", {}),
+                     "step": 0}
 
-        def loss_fn(leaf_list, bstats, X, y):
+        def loss_fn(leaf_list, bstats, step, X, y):
             p = jax.tree_util.tree_unflatten(treedef, leaf_list)
-            logits, updates = net.apply(
-                {"params": p, "batch_stats": bstats}, X, train=True,
-                mutable=["batch_stats"])
+            vs = {"params": p, **({"batch_stats": bstats} if has_bn
+                                  else {})}
+            # fresh dropout mask per step: fold the step counter into
+            # the key (a closed-over key would bake ONE mask into the
+            # jitted trace and train a fixed subnetwork)
+            rngs = {"dropout": jax.random.fold_in(
+                jax.random.PRNGKey(7), step)}
+            if has_bn:
+                logits, updates = net.apply(vs, X, train=True,
+                                            mutable=["batch_stats"],
+                                            rngs=rngs)
+                new_bs = updates["batch_stats"]
+            else:
+                logits = net.apply(vs, X, train=True, rngs=rngs)
+                new_bs = bstats
             one_hot = jax.nn.one_hot(y, num_classes)
             loss = -jnp.mean(
                 jnp.sum(jax.nn.log_softmax(logits) * one_hot, axis=-1))
-            return loss, updates["batch_stats"]
+            return loss, new_bs
 
         @jax.jit
-        def _grad_step(leaf_list, bstats, X, y):
+        def _grad_step(leaf_list, bstats, step, X, y):
             (loss, new_bs), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(leaf_list, bstats, X, y)
+                loss_fn, has_aux=True)(leaf_list, bstats, step, X, y)
             return loss, grads, new_bs
 
         def grad_step(leaf_list, X, y):
+            step = state_box["step"]
+            state_box["step"] = step + 1
             loss, grads, state_box["batch_stats"] = _grad_step(
-                leaf_list, state_box["batch_stats"], X, y)
+                leaf_list, state_box["batch_stats"],
+                jnp.asarray(step, jnp.int32), X, y)
             return loss, grads
 
         @jax.jit
         def _eval_step(leaf_list, bstats, X, y):
             p = jax.tree_util.tree_unflatten(treedef, leaf_list)
-            logits = net.apply({"params": p, "batch_stats": bstats}, X)
+            vs = {"params": p, **({"batch_stats": bstats} if has_bn
+                                  else {})}
+            logits = net.apply(vs, X)
             pred = jnp.argmax(logits, axis=-1)
             return jnp.mean((pred == y).astype(jnp.float32))
 
         def eval_step(leaf_list, X, y):
             return _eval_step(leaf_list, state_box["batch_stats"], X, y)
-
-    else:
-        raise ValueError(f"unknown model {model!r}")
 
     # writable host copies (np.asarray of a jax array is a read-only view)
     return ([np.array(l, copy=True) for l in leaves], treedef, grad_step,

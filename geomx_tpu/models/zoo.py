@@ -407,6 +407,5 @@ def get_model(name: str, num_classes: int = 1000,
 
         base = name.split("_")[0]  # resnet50_v1 -> resnet50
         return create_resnet(base, num_classes=num_classes,
-                             small_images=False,
-                             compute_dtype=compute_dtype)
+                             compute_dtype=compute_dtype, **kwargs)
     raise ValueError(f"unknown model {name!r}")

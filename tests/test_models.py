@@ -124,3 +124,24 @@ def test_rnn_family_shapes_and_learning():
         params, st, l = step(params, st)
         losses.append(float(l))
     assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+
+def test_build_model_and_step_zoo_models():
+    """The example harness trains any vision-zoo name (BN and
+    dropout-only nets both): one grad step + eval runs and params
+    update."""
+    import jax.numpy as jnp
+    import numpy as np
+    from examples.utils import build_model_and_step
+
+    X = jnp.asarray(np.random.RandomState(0).rand(4, 32, 32, 3),
+                    jnp.float32)
+    y = jnp.asarray(np.arange(4) % 10)
+    for name in ("mobilenet0.25", "vgg11"):
+        leaves, _td, grad_step, eval_step = build_model_and_step(
+            4, input_shape=(32, 32, 3), model=name)
+        loss, grads = grad_step([jnp.asarray(l) for l in leaves], X, y)
+        assert np.isfinite(float(loss))
+        assert any(float(jnp.max(jnp.abs(g))) > 0 for g in grads)
+        acc = eval_step([jnp.asarray(l) for l in leaves], X, y)
+        assert 0.0 <= float(acc) <= 1.0
