@@ -29,6 +29,8 @@ from geomx_tpu import metric  # noqa: F401  (mirrors mx.metric)
 # ops must be importable from sys.modules by handler threads while this
 # package import is still in progress (see compression._ops)
 from geomx_tpu import ops  # noqa: F401
+from geomx_tpu import initializer  # noqa: F401  (mirrors mx.init)
+from geomx_tpu import lr_scheduler  # noqa: F401
 from geomx_tpu import optimizer  # noqa: F401
 from geomx_tpu import profiler  # noqa: F401  (mirrors mx.profiler)
 from geomx_tpu.kvstore import create  # noqa: F401

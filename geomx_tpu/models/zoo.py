@@ -406,6 +406,9 @@ def get_model(name: str, num_classes: int = 1000,
         from geomx_tpu.models.resnet import create_resnet
 
         base = name.split("_")[0]  # resnet50_v1 -> resnet50
+        # ImageNet stem by default (gluon-parity); create_resnet's own
+        # default is the CIFAR stem, so pin it unless the caller asks
+        kwargs.setdefault("small_images", False)
         return create_resnet(base, num_classes=num_classes,
                              compute_dtype=compute_dtype, **kwargs)
     raise ValueError(f"unknown model {name!r}")
