@@ -28,7 +28,7 @@ from geomx_tpu.simulate import free_port as _free_port
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_vanilla_hips_subprocess_topology():
+def _run_launch(script: str, extra_args, n_iters: int, timeout: float):
     env = dict(os.environ)
     env.update({
         "GPORT": str(_free_port()), "CPORT": str(_free_port()),
@@ -40,13 +40,13 @@ def test_vanilla_hips_subprocess_topology():
         "XLA_FLAGS": "",
     })
     proc = subprocess.Popen(
-        ["bash", os.path.join(REPO, "scripts", "run_vanilla_hips.sh"),
-         "--max-iters", "15"],
+        ["bash", os.path.join(REPO, "scripts", script),
+         "--max-iters", str(n_iters), *extra_args],
         cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True, start_new_session=True,
     )
     try:
-        out, _ = proc.communicate(timeout=240)
+        out, _ = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
         os.killpg(proc.pid, signal.SIGKILL)
         out, _ = proc.communicate()
@@ -54,10 +54,8 @@ def test_vanilla_hips_subprocess_topology():
 
     assert proc.returncode == 0, f"launch failed:\n{out[-4000:]}"
     accs = [float(m) for m in re.findall(r"Test Acc (\d+\.\d+)", out)]
-    assert len(accs) == 15, f"expected 15 iteration lines, got:\n{out[-4000:]}"
-    # the correctness signal: training must actually learn (random = 0.1)
-    assert max(accs[-5:]) > 0.4, f"accuracy did not climb: {accs}"
-    assert max(accs[-5:]) > accs[0], f"accuracy did not improve: {accs}"
+    assert len(accs) == n_iters, \
+        f"expected {n_iters} iteration lines, got:\n{out[-4000:]}"
 
     # clean exits: every background process of the group must terminate
     deadline = time.monotonic() + 60
@@ -70,6 +68,26 @@ def test_vanilla_hips_subprocess_topology():
     else:
         os.killpg(proc.pid, signal.SIGKILL)
         pytest.fail("background topology processes did not exit cleanly")
+    return accs
+
+
+def test_vanilla_hips_subprocess_topology():
+    accs = _run_launch("run_vanilla_hips.sh", [], n_iters=15, timeout=240)
+    # the correctness signal: training must actually learn (random = 0.1)
+    assert max(accs[-5:]) > 0.4, f"accuracy did not climb: {accs}"
+    assert max(accs[-5:]) > accs[0], f"accuracy did not improve: {accs}"
+
+
+def test_bsc_subprocess_topology():
+    """The BASELINE headline config through the REAL launch chain:
+    cnn_bsc.py (aggregator PS, worker-side Adam, BSC both directions).
+    cr=0.05 gives a test-budget-friendly learning signal (the 1%
+    default learns too, over hundreds of iterations)."""
+    accs = _run_launch("run_bsc.sh", ["-cr", "0.05"], n_iters=48,
+                       timeout=360)
+    assert max(accs[-8:]) > 0.4, f"BSC accuracy did not climb: {accs}"
+    assert max(accs[-8:]) > accs[0] + 0.15, \
+        f"BSC accuracy did not improve: {accs}"
 
 
 if __name__ == "__main__":
