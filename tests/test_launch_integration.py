@@ -99,5 +99,15 @@ def test_bsc_subprocess_topology():
     assert len(set(accs[-20:])) > 3, f"accuracy never moved: {accs}"
 
 
+
+def test_mixed_sync_subprocess_topology():
+    """MixedSync (dist_async: per-push global updates, no global
+    barrier) through the real launch chain. Deterministic across runs
+    (two calibration trials produced identical curves)."""
+    accs = _run_launch("run_mixed_sync.sh", [], n_iters=15, timeout=240)
+    assert max(accs[-5:]) > 0.3, f"MixedSync did not learn: {accs}"
+    assert max(accs[-5:]) > accs[0], f"no improvement: {accs}"
+
+
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-x", "-q"]))
