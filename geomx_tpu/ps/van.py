@@ -423,11 +423,14 @@ class Van:
             try:
                 self._send_one(msg.meta.recver, msg)  # retries once internally
             except OSError as e:
-                # TODO(resender): hand to the ACK/retransmit layer when it
-                # lands; until then surface loudly — a lost data message
-                # stalls the requester until its wait() timeout
-                log.error("priority send to %d failed permanently: %s",
-                          msg.meta.recver, e)
+                # with PS_RESEND on, _send_one_inner already registered
+                # the message for retransmission before this attempt, so
+                # the monitor retries it; without the resender a lost
+                # data message stalls the requester until its wait()
+                # timeout — surface loudly either way
+                log.error("priority send to %d failed (resender %s): %s",
+                          msg.meta.recver,
+                          "will retry" if self._resender else "off", e)
 
     def _send_one(self, target: int, msg: Message) -> int:
         if profiler.is_running() and not msg.is_control:
