@@ -28,7 +28,8 @@ from geomx_tpu.simulate import free_port as _free_port
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_launch(script: str, extra_args, n_iters: int, timeout: float):
+def _run_launch(script: str, extra_args, n_iters: int, timeout: float,
+                expect_lines: int = 0):
     env = dict(os.environ)
     env.update({
         "GPORT": str(_free_port()), "CPORT": str(_free_port()),
@@ -54,8 +55,9 @@ def _run_launch(script: str, extra_args, n_iters: int, timeout: float):
 
     assert proc.returncode == 0, f"launch failed:\n{out[-4000:]}"
     accs = [float(m) for m in re.findall(r"Test Acc (\d+\.\d+)", out)]
-    assert len(accs) == n_iters, \
-        f"expected {n_iters} iteration lines, got:\n{out[-4000:]}"
+    expect = expect_lines or n_iters
+    assert len(accs) == expect, \
+        f"expected {expect} iteration lines, got:\n{out[-4000:]}"
 
     # clean exits: every background process of the group must terminate
     deadline = time.monotonic() + 60
@@ -107,6 +109,15 @@ def test_mixed_sync_subprocess_topology():
     accs = _run_launch("run_mixed_sync.sh", [], n_iters=15, timeout=240)
     assert max(accs[-5:]) > 0.3, f"MixedSync did not learn: {accs}"
     assert max(accs[-5:]) > accs[0], f"no improvement: {accs}"
+
+
+def test_hfa_subprocess_topology():
+    """HFA (K1 local steps per LAN sync, K2-periodic WAN rounds)
+    through the real launch chain; prints every K1=2 iterations.
+    Deterministic (two calibration trials identical: 0.7471 @ 20)."""
+    accs = _run_launch("run_hfa.sh", [], n_iters=20, timeout=240,
+                       expect_lines=10)
+    assert max(accs[-4:]) > 0.5, f"HFA did not learn: {accs}"
 
 
 if __name__ == "__main__":
