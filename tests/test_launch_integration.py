@@ -120,5 +120,20 @@ def test_hfa_subprocess_topology():
     assert max(accs[-4:]) > 0.5, f"HFA did not learn: {accs}"
 
 
+def test_fp16_subprocess_topology():
+    """FP16 wire transmission through the real launch chain
+    (deterministic: calibration trials identical, 0.6934 @ 15)."""
+    accs = _run_launch("run_fp16.sh", [], n_iters=15, timeout=240)
+    assert max(accs[-5:]) > 0.5, f"FP16 did not learn: {accs}"
+
+
+def test_mpq_subprocess_topology():
+    """MPQ (size-threshold fp16/bsc routing) through the real launch
+    chain (near-deterministic: 0.775-0.782 @ 25 across trials; the BSC
+    component adds slight variance)."""
+    accs = _run_launch("run_mpq.sh", [], n_iters=25, timeout=300)
+    assert max(accs[-8:]) > 0.5, f"MPQ did not learn: {accs}"
+
+
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-x", "-q"]))
