@@ -206,22 +206,68 @@ class PrefetchIter:
     def __iter__(self) -> Iterator[Batch]:
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         err: list = []
+        stop = threading.Event()
 
         def produce():
             try:
                 for item in self.base:
-                    q.put(item)
+                    # Bounded-timeout put so an abandoned consumer (break /
+                    # exception in the for-loop body) cannot strand this
+                    # thread on a full queue forever.
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
             except BaseException as e:  # noqa: BLE001 — re-raised below
                 err.append(e)
             finally:
-                q.put(self._DONE)
+                while True:
+                    try:
+                        q.put(self._DONE, timeout=0.1)
+                        break
+                    except queue.Full:
+                        # Only discard queued items to make room when the
+                        # consumer has already gone away — never on normal
+                        # completion (that would drop real batches).
+                        if stop.is_set():
+                            try:
+                                q.get_nowait()
+                            except queue.Empty:
+                                pass
 
-        t = threading.Thread(target=produce, daemon=True)
+        t = threading.Thread(target=produce, daemon=True,
+                             name="geomx-prefetch")
         t.start()
-        while True:
-            item = q.get()
-            if item is self._DONE:
-                if err:
-                    raise err[0]
-                return
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is self._DONE:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            # Runs on normal exhaustion AND on GeneratorExit (consumer break
+            # or GC): release the producer so reset()+re-iteration doesn't
+            # race a live thread against the base iterator.
+            stop.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=5.0)
+            if t.is_alive():
+                # Producer is stuck inside base.__next__ (slow IO source) —
+                # it cannot be interrupted, so reset()+re-iteration would
+                # race it against the base iterator. Surface that loudly.
+                import warnings
+                warnings.warn(
+                    "PrefetchIter producer did not exit within 5 s; it is "
+                    "blocked inside the base iterator. Do not reset() and "
+                    "re-iterate until it finishes.", RuntimeWarning,
+                    stacklevel=2)

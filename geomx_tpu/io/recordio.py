@@ -41,6 +41,10 @@ def pack(header: IRHeader, s: bytes) -> bytes:
         label = np.asarray(label, np.float32)
         header = header._replace(flag=label.size, label=0.0)
         return (struct.pack(_IR_FORMAT, *header) + label.tobytes() + s)
+    # Scalar label: flag must be 0 (reference recordio.py forces this) —
+    # a caller-supplied flag > 0 would make unpack() consume 4*flag payload
+    # bytes as labels and corrupt the body.
+    header = header._replace(flag=0)
     return struct.pack(_IR_FORMAT, *header) + s
 
 

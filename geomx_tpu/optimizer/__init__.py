@@ -505,7 +505,11 @@ class DCASGD(Optimizer):
             new_w = weight + state["mom"]
         else:
             new_w = weight - lr * comp
-        state["prev"] = np.array(new_w, dtype=np.float32, copy=True)
+        # Snapshot the PRE-update weight (reference: optimizer.py:924
+        # previous_weight[:] = weight before the update), so the next call's
+        # (weight - prev) spans exactly one update and the delay-compensation
+        # term is nonzero for stale gradients.
+        state["prev"] = np.array(weight, dtype=np.float32, copy=True)
         return new_w
 
 

@@ -142,3 +142,31 @@ def test_recordio_rejects_corrupt_magic(tmp_path):
     with MXRecordIO(str(p), "r") as r:
         with pytest.raises(IOError, match="magic"):
             r.read()
+
+
+def test_prefetch_iter_early_exit_releases_producer():
+    """ADVICE r3: breaking out of a PrefetchIter must not strand the
+    producer thread on a full queue; a subsequent reset+re-iteration must
+    see the full sequence again."""
+    import threading
+
+    X, y = _data(64)
+    pre = PrefetchIter(NDArrayIter(X, y, batch_size=4), prefetch=1)
+    it = iter(pre)
+    next(it)  # consume one batch, abandon the rest
+    it.close()
+    # producer must have exited (close() joins with a 5 s timeout)
+    assert not any(t.name == "geomx-prefetch"
+                   for t in threading.enumerate())
+    pre.reset()
+    assert len(list(pre)) == 16
+
+
+def test_pack_scalar_label_forces_flag_zero():
+    """ADVICE r3: a caller-constructed IRHeader with flag>0 and a scalar
+    label must not claim extra float32 labels in the written record."""
+    body = pack(IRHeader(flag=3, label=1.0, id=7, id2=0), b"payload")
+    header, payload = unpack(body)
+    assert header.flag == 0
+    assert header.label == 1.0
+    assert payload == b"payload"

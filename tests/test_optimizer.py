@@ -266,3 +266,21 @@ def test_scheduler_travels_in_pickle():
     np.testing.assert_allclose(
         np.asarray(opt.update(0, w.copy(), g)),
         np.asarray(clone.update(0, w.copy(), g)))
+
+
+def test_dcasgd_prev_is_pre_update_weight():
+    """ADVICE r3 (medium): state['prev'] must snapshot the PRE-update
+    weight (reference optimizer.py:924) so the compensation term
+    lamda*g*g*(w - prev) is nonzero on the next stale gradient."""
+    opt = DCASGD(learning_rate=0.1, lamda=0.04)
+    w0 = np.array([1.0, -2.0], np.float32)
+    g = np.array([0.5, 0.5], np.float32)
+    state = opt.create_state(0, w0)
+    w1 = opt.step(0, w0, g, state, 0.1)
+    # prev now holds w0 (pre-update), not w1
+    np.testing.assert_allclose(state["prev"], w0)
+    # second step: compensation term must fire (w1 != prev)
+    comp = g + opt.lamda * g * g * (w1 - w0)
+    expected = w1 - 0.1 * comp
+    w2 = opt.step(0, w1, g, state, 0.1)
+    np.testing.assert_allclose(w2, expected, rtol=1e-6)
