@@ -1,0 +1,41 @@
+"""The bench accuracy-parity gate (round-3 verdict item 2).
+
+A throughput headline at broken accuracy must not publish: bench.main()
+zeroes the headline, attaches ``parity_failed``, and exits nonzero.
+"""
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_round3_regression_would_have_failed():
+    bench = _load_bench()
+    # the recorded r03 run: nokv 1.0, hips 1.0, bsc 0.9668
+    fails = bench.parity_violations(1.0, 1.0, 0.9668)
+    assert [f["config"] for f in fails] == ["hips_bsc_cnn"]
+    assert fails[0]["tol"] == bench.PARITY_TOL_BSC
+
+
+def test_within_tolerance_passes():
+    bench = _load_bench()
+    assert bench.parity_violations(1.0, 0.99, 0.985) == []
+    # better-than-baseline never fails
+    assert bench.parity_violations(0.9, 1.0, 1.0) == []
+
+
+def test_fsa_breakage_named():
+    bench = _load_bench()
+    fails = bench.parity_violations(1.0, 0.5, 1.0)
+    assert [f["config"] for f in fails] == ["hips_cnn"]
