@@ -211,8 +211,17 @@ def _generic_decompress(tag, val, aux, orig_len):
             np.add.at(out.reshape(n_rows, row_len), ids, rows)
         return out
     if tag == "bsc":
+        # scatter-ADD, not assignment: a push payload carrying duplicate
+        # indices must aggregate by sum (same contract as the "rsp"
+        # branch above); for pull payloads indices are unique (nonzeros
+        # of one array) so add and set coincide
         assert aux is not None, "bsc payload missing index aux array"
-        return bsc_decompress(val, aux, orig_len)
+        idx = np.asarray(aux, dtype=np.int64).ravel()
+        vals = np.asarray(val, dtype=np.float32).ravel()
+        out = np.zeros(orig_len, dtype=np.float32)
+        ok = (idx >= 0) & (idx < orig_len)
+        np.add.at(out, idx[ok], vals[ok])
+        return out
     if tag == "2bit":
         assert aux is not None and aux.size == 1, "2bit payload missing threshold"
         return two_bit_dequantize(val, orig_len, float(aux[0]))

@@ -721,16 +721,35 @@ class KVStoreDistServer:
         else:
             lo, hi = st.offset, st.offset + st.length
         data = st.stored[lo - st.offset:hi - st.offset]
-        if req_compr == "bsc" and self.updater is not None:
-            # BSC pull-compression assumes the store holds a SPARSE gradient
-            # aggregate (no server-side optimizer — reference cnn_bsc.py uses
-            # a local Trainer); with an updater the store is dense weights
-            # and the non-zero filter would truncate them. Serve dense.
-            if not getattr(self, "_warned_bsc_dense", False):
-                self._warned_bsc_dense = True
-                log.warning("BSC pull-compression disabled: an optimizer is "
-                            "set, the store holds dense weights")
-            req_compr = ""
+        if req_compr == "bsc":
+            if self.updater is not None:
+                # BSC pull-compression assumes the store holds a SPARSE
+                # gradient aggregate (no server-side optimizer — reference
+                # cnn_bsc.py uses a local Trainer); with an updater the
+                # store is dense weights and the non-zero filter would
+                # truncate them. Serve dense.
+                if not getattr(self, "_warned_bsc_dense", False):
+                    self._warned_bsc_dense = True
+                    log.warning("BSC pull-compression disabled: an optimizer "
+                                "is set, the store holds dense weights")
+                req_compr = ""
+            else:
+                # Aggregator mode: the store holds the round's aggregated
+                # gradient, whose support is bounded by (workers x top-k) —
+                # serve its EXACT nonzero set. Divergence from the
+                # reference's BSCPullCompress capacity cap
+                # (gradient_compression.cc:271: threshold*multiplier,
+                # truncating beyond it): our wire carries variable-length
+                # (values, indices), so the lossless superset costs the
+                # same protocol and never drops aggregate entries. Works
+                # with or without a compressor configured.
+                nz = np.nonzero(data)[0]
+                out = KVPairs(keys=[key],
+                              vals=[data[nz].astype(np.float32)],
+                              aux=[nz.astype(np.int32)], offsets=[lo],
+                              totals=[st.total], lens=[hi - lo],
+                              compr="bsc")
+                return lambda: srv.response(req, out)
         if req_compr:
             # pull-side compression on the WAN hop (reference:
             # DefaultStorageResponse BSC branch, :1190-1210)
@@ -752,10 +771,12 @@ class KVStoreDistServer:
         pulls, st.pending_pulls = st.pending_pulls, []
         for req, srv, off, length, compr, aux in pulls:
             # dense flushes drop pull-compression (the fresh store holds
-            # weights); row-sparse keeps its format
+            # weights); row-sparse keeps its format, and "bsc" keeps its
+            # sparse response (it self-downgrades to dense in
+            # _pull_response_action when an updater holds dense weights)
             acts.append(self._pull_response_action(
                 st, req, srv, key, off, length,
-                compr if compr == "rsp" else "", aux))
+                compr if compr in ("rsp", "bsc") else "", aux))
         return acts
 
     # ------------------------------------------------------------------

@@ -7,11 +7,23 @@ PCIe-local, ruinous when it is not, and wasteful everywhere. Here the
 parameters stay resident on the device as one flat fp32 vector and the
 host<->device link carries only:
 
-- down: the BSC-selected (values, indices) of the momentum-corrected
-  gradient (``ops.bsc_compress`` — top-k on device, reference
-  semantics: gradient_compression.cc:191 BSCompress);
+- down: the PER-KEY BSC-selected (values, indices) of the
+  momentum-corrected gradient (top-k per tensor on device, matching the
+  reference's per-tensor compression — reference semantics:
+  gradient_compression.cc:191 BSCompress runs per key);
 - up: the nonzeros of the aggregated gradient pulled back from the
-  HiPS tier (bounded by workers x k).
+  HiPS tier (bounded by workers x k), as one fixed-size padded array so
+  the jitted apply never retraces.
+
+Indices travel as int32 BITCAST into the float32 payload
+(lax.bitcast_convert_type), so any index a flat int32 can address is
+exact — models up to 2^31 parameters per trainer (the round-3 float32
+mantissa packing capped this at 2^24).
+
+The LAN hop is element-sparse when the kvstore supports it
+(KVStoreDist.push_bsc / pull_bsc — O(k) bytes and host work per key);
+stores without the sparse wire (e.g. the single-process "local" store)
+fall back to a dense scatter per key.
 
 KVStore semantics follow examples/cnn_bsc.py: the PS tier is an
 AGGREGATOR (no server-side optimizer); every worker applies the same
@@ -27,8 +39,7 @@ grads, the standard treatment).
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, List, Sequence, Tuple
 
 import numpy as np
 
@@ -59,8 +70,15 @@ class DeviceResidentTrainer:
         self._offsets = np.concatenate(
             [[0], np.cumsum(self._sizes)]).astype(np.int64)
         self.total = int(self._offsets[-1])
-        self.k = max(int(self.total * threshold), 1)
-        bounds = list(self._offsets[1:-1])
+        if self.total >= 1 << 31:
+            raise ValueError("DeviceResidentTrainer addresses elements "
+                             f"with int32: < 2^31 params, got {self.total}")
+        # per-key top-k (reference per-tensor BSC: every tensor keeps
+        # ceil(size * threshold) coordinates, minimum 1)
+        self._ks = [max(int(sz * threshold), 1) for sz in self._sizes]
+        self.k = sum(self._ks)
+        self._kofs = np.concatenate([[0], np.cumsum(self._ks)]).astype(
+            np.int64)
 
         # kv bootstrap: init + pull once (the only full-weight transfer)
         for i, leaf in enumerate(leaves):
@@ -77,7 +95,10 @@ class DeviceResidentTrainer:
         self._mom = (jax.device_put(jnp.zeros(self.total, jnp.float32))
                      if momentum else None)
 
-        shapes, k = self._shapes, self.k
+        shapes = self._shapes
+        bounds = list(self._offsets[1:-1])
+        offsets = [int(o) for o in self._offsets[:-1]]
+        sizes, ks = self._sizes, self._ks
         # scale by the TOTAL worker count across parties (the global
         # tier sums every party's aggregate), not the party-local count
         nw = max(int(getattr(self.kv, "num_all_workers", 0)
@@ -86,11 +107,8 @@ class DeviceResidentTrainer:
         # the aggregate has <= nw*k nonzeros; padding the upload to that
         # FIXED size keeps one compiled apply (a shape that varied per
         # round would retrace/recompile jit every step)
-        self._up_cap = m = nw * k
-        # indices ride the float32 payload (exact below 2^24)
-        if self.total >= 1 << 24:
-            raise ValueError("DeviceResidentTrainer supports < 2^24 "
-                             f"parameters per trainer, got {self.total}")
+        self._up_cap = m = nw * self.k
+        K = self.k
 
         @jax.jit
         def fwd_compress(flat, u, v, X, y):
@@ -98,24 +116,32 @@ class DeviceResidentTrainer:
                   zip(jnp.split(flat, bounds), shapes)]
             loss, grads = grad_fn(lv, X, y)
             g = jnp.concatenate([gg.reshape(-1) for gg in grads]) / nw
-            # BSC: momentum-corrected accumulation, exact top-k
-            # (reference: gradient_compression.cc:191-268)
+            # BSC: momentum-corrected accumulation, exact per-key top-k
+            # (reference: gradient_compression.cc:191-268, per tensor)
             u = 0.9 * u + g
             v = v + u
-            _mags, idx = jax.lax.top_k(jnp.abs(v), k)
-            vals = v[idx]
+            vals_parts, idx_parts = [], []
+            for off, sz, kk in zip(offsets, sizes, ks):
+                seg = v[off:off + sz]
+                _mags, ii = jax.lax.top_k(jnp.abs(seg), kk)
+                vals_parts.append(seg[ii])
+                idx_parts.append((ii + off).astype(jnp.int32))
+            vals = jnp.concatenate(vals_parts)
+            idx = jnp.concatenate(idx_parts)       # model-flat positions
             v = v.at[idx].set(0.0)
             u = u.at[idx].set(0.0)
-            # single packed transfer: [loss, vals(k), idx(k) as f32]
+            # single packed transfer: [loss, vals(K), idx(K) bitcast f32]
             packed = jnp.concatenate(
                 [loss[None].astype(jnp.float32), vals,
-                 idx.astype(jnp.float32)])
+                 jax.lax.bitcast_convert_type(idx, jnp.float32)])
             return packed, u, v
 
         @jax.jit
         def apply_sgd(flat, mom, packed):
-            vals, fidx = packed[:m], packed[m:]
-            g = jnp.zeros_like(flat).at[fidx.astype(jnp.int32)].add(vals)
+            vals = packed[:m]
+            idx = jax.lax.bitcast_convert_type(packed[m:], jnp.int32)
+            # pad slots carry (val 0.0, idx 0): a scatter-add no-op
+            g = jnp.zeros_like(flat).at[idx].add(vals)
             if mom is None:
                 return flat - learning_rate * g, None
             mom = momentum * mom + g
@@ -123,6 +149,9 @@ class DeviceResidentTrainer:
 
         self._fwd_compress = fwd_compress
         self._apply = apply_sgd
+        self._K = K
+        self._sparse_wire = (hasattr(self.kv, "push_bsc")
+                             and hasattr(self.kv, "pull_bsc"))
 
     def warmup(self, X, y) -> None:
         """Trace+compile both device steps WITHOUT running a kv round
@@ -146,52 +175,78 @@ class DeviceResidentTrainer:
 
         packed_d, self._u, self._v = self._fwd_compress(
             self._flat, self._u, self._v, X, y)
-        # ONE compact device->host transfer (1 + 2k floats vs total)
+        # ONE compact device->host transfer (1 + 2K floats vs total)
         packed = np.asarray(packed_d)
         loss = float(packed[0])
-        vals = packed[1:1 + self.k]
-        idx = packed[1 + self.k:].astype(np.int64)
-        agg = self._aggregate_sparse(vals, idx)
-        ups, upi = self._nonzeros(agg)
+        vals = packed[1:1 + self._K]
+        idx = packed[1 + self._K:].view(np.int32).astype(np.int64)
+        if self._sparse_wire:
+            ups, upi = self._kv_round_sparse(vals, idx)
+        else:
+            ups, upi = self._kv_round_dense(vals, idx)
         # ONE compact FIXED-SIZE host->device transfer; apply locally
-        # (cnn_bsc worker-side optimizer semantics). Pad slot: index 0
-        # with value 0 — a scatter-add no-op.
-        up = np.zeros(2 * self._up_cap, np.float32)
+        # (cnn_bsc worker-side optimizer semantics).
         n = len(ups)
+        if n > self._up_cap:
+            raise RuntimeError(
+                f"aggregated selection ({n}) exceeds the upload capacity "
+                f"({self._up_cap}) — is the PS tier running an optimizer? "
+                "DeviceResidentTrainer requires aggregator mode")
+        up = np.zeros(2 * self._up_cap, np.float32)
         up[:n] = ups
-        up[self._up_cap:self._up_cap + n] = upi.astype(np.float32)
+        up[self._up_cap:self._up_cap + n] = \
+            upi.astype(np.int32).view(np.float32)
         self._flat, self._mom = self._apply(
             self._flat, self._mom, jax.device_put(up))
         return loss
 
     # -- host-side kv round ----------------------------------------------
 
-    def _aggregate_sparse(self, vals: np.ndarray, idx: np.ndarray
-                          ) -> List[np.ndarray]:
-        """Scatter the compact selection into per-key dense buffers,
-        run the push/pull round, return per-key aggregated grads."""
-        outs: List[np.ndarray] = []
-        for i, (off, sz) in enumerate(zip(self._offsets[:-1], self._sizes)):
-            sel = (idx >= off) & (idx < off + sz)
+    def _kv_round_sparse(self, vals: np.ndarray, idx: np.ndarray
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+        """Element-sparse LAN round: O(k_i) bytes and host work per key.
+        The fwd layout is per-key contiguous (segment i covers
+        kofs[i]:kofs[i+1]), so partitioning is slicing, not scanning."""
+        handles = []
+        for i in range(len(self._sizes)):
+            lo, hi = int(self._kofs[i]), int(self._kofs[i + 1])
+            key = self.begin_key + i
+            off = int(self._offsets[i])
+            self.kv.push_bsc(key, vals[lo:hi], idx[lo:hi] - off,
+                             priority=-i)
+            handles.append((i, self.kv.pull_bsc(key, priority=-i)))
+        ups, upi = [], []
+        for i, join in handles:
+            avals, aidx = join()
+            ups.append(avals)
+            upi.append(aidx + int(self._offsets[i]))
+        return np.concatenate(ups), np.concatenate(upi)
+
+    def _kv_round_dense(self, vals: np.ndarray, idx: np.ndarray
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+        """Dense fallback for stores without the sparse wire (e.g. the
+        in-process "local" store): scatter each key's selection into a
+        dense buffer, push/pull, gather nonzeros."""
+        ups, upi = [], []
+        for i, (off, sz) in enumerate(zip(self._offsets[:-1],
+                                          self._sizes)):
+            lo, hi = int(self._kofs[i]), int(self._kofs[i + 1])
             dense = np.zeros(sz, np.float32)
-            dense[idx[sel] - off] = vals[sel]
+            dense[idx[lo:hi] - off] = vals[lo:hi]
             key = self.begin_key + i
             self.kv.push(key, dense.reshape(self._shapes[i]), priority=-i)
             out = np.zeros(self._shapes[i], np.float32)
             self.kv.pull(key, out=out, priority=-i)
-            outs.append(out)
+            ups.append(out)
+            upi.append(off)
         self.kv.wait()
-        return outs
-
-    def _nonzeros(self, outs: List[np.ndarray]
-                  ) -> Tuple[np.ndarray, np.ndarray]:
-        vals, idxs = [], []
-        for i, (off, out) in enumerate(zip(self._offsets[:-1], outs)):
+        cat_v, cat_i = [], []
+        for out, off in zip(ups, upi):
             flat = out.ravel()
             nz = np.nonzero(flat)[0]
-            vals.append(flat[nz].astype(np.float32))
-            idxs.append((nz + off).astype(np.int32))
-        return np.concatenate(vals), np.concatenate(idxs)
+            cat_v.append(flat[nz].astype(np.float32))
+            cat_i.append(nz + off)
+        return np.concatenate(cat_v), np.concatenate(cat_i)
 
     # -- escape hatch ----------------------------------------------------
 

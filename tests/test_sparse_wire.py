@@ -1,0 +1,149 @@
+"""Element-sparse push/pull wire (KVStoreDist.push_bsc / pull_bsc).
+
+The TPU-native BSC LAN hop (round-3 verdict item 3): a worker ships its
+on-chip top-k selection as (values, indices) — O(k) bytes — the server
+scatters to dense for aggregation, and a "bsc"-tagged pull returns the
+aggregated gradient's exact nonzero set. Semantics must equal a dense
+push of the scattered selection.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from geomx_tpu.simulate import InProcessHiPS
+
+
+def _run_workers(topo, worker_fn, master_init, timeout=300):
+    errs = []
+
+    def run():
+        try:
+            topo.run_workers(worker_fn, include_master=master_init,
+                             timeout=timeout)
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join(timeout)
+    assert not t.is_alive(), "workers hung"
+    if errs:
+        raise errs[0]
+
+
+@pytest.mark.parametrize("sharded", [False, True])
+def test_push_bsc_aggregates_and_pull_bsc_is_exact(sharded):
+    """Two workers push overlapping sparse selections; the aggregated
+    pull-back (sparse wire) must equal the dense pull exactly —
+    overlapping indices sum, disjoint ones pass through."""
+    n = 40
+    # sharded=True: two local servers + a bigarray bound below the key
+    # size forces the selection to be partitioned across server shards
+    kw = dict(num_parties=2, workers_per_party=1)
+    if sharded:
+        kw.update(servers_per_party=2, bigarray_bound=16)
+    topo = InProcessHiPS(**kw).start()
+    results = {}
+    try:
+        def master_init(kv):
+            kv.init(7, np.zeros(n, np.float32))
+            kv.wait()
+
+        def worker(kv):
+            widx = 0 if kv is topo.workers[0] else 1
+            kv.init(7, np.zeros(n, np.float32))
+            kv.pull(7, out=np.zeros(n, np.float32))
+            kv.wait()
+            if widx == 0:
+                idx = np.array([0, 5, 17, 33], np.int64)
+                vals = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+            else:
+                idx = np.array([5, 20, 39], np.int64)
+                vals = np.array([10.0, 20.0, 30.0], np.float32)
+            kv.push_bsc(7, vals, idx)
+            join = kv.pull_bsc(7)
+            avals, aidx = join()
+            dense = np.zeros(n, np.float32)
+            dense[aidx] = avals
+            results[widx] = dense
+
+        _run_workers(topo, worker, master_init)
+    finally:
+        topo.stop()
+
+    expect = np.zeros(n, np.float32)
+    expect[[0, 5, 17, 33]] += [1.0, 2.0, 3.0, 4.0]
+    expect[[5, 20, 39]] += [10.0, 20.0, 30.0]
+    np.testing.assert_allclose(results[0], expect)
+    np.testing.assert_array_equal(results[0], results[1])
+
+
+def test_push_bsc_range_check():
+    topo = InProcessHiPS(num_parties=2, workers_per_party=1).start()
+    try:
+        def master_init(kv):
+            kv.init(3, np.zeros(8, np.float32))
+            kv.wait()
+
+        def worker(kv):
+            kv.init(3, np.zeros(8, np.float32))
+            kv.wait()
+            with pytest.raises(IndexError):
+                kv.push_bsc(3, np.ones(1, np.float32),
+                            np.array([8], np.int64))
+            # the failed push must not poison the round: a clean
+            # round still completes
+            kv.push_bsc(3, np.ones(1, np.float32),
+                        np.array([2], np.int64))
+            avals, aidx = kv.pull_bsc(3)()
+            dense = np.zeros(8, np.float32)
+            dense[aidx] = avals
+            np.testing.assert_allclose(dense[2], 2.0)
+
+        _run_workers(topo, worker, master_init)
+    finally:
+        topo.stop()
+
+
+def test_trainer_indices_beyond_2p24():
+    """Round-3 verdict item 3: the float32-mantissa index packing capped
+    the trainer at 2^24 params. Indices now travel as bitcast int32 —
+    verify exactness of a selection ABOVE 2^24 on a 17M-element leaf."""
+    import jax.numpy as jnp
+
+    from geomx_tpu.kvstore import create as kv_create
+    from geomx_tpu.trainer_device import DeviceResidentTrainer
+
+    n = (1 << 24) + 64          # would have raised pre-fix
+    spike = (1 << 24) + 37      # not representable in a f32 mantissa +1
+
+    def grad_fn(leaves, X, y):
+        w = leaves[0]
+        g = jnp.zeros_like(w).at[spike].set(100.0).at[3].set(-50.0)
+        return jnp.sum(w * 0.0), [g]
+
+    kv = kv_create("local")
+    tr = DeviceResidentTrainer(
+        [np.zeros(n, np.float32)], kv, grad_fn,
+        threshold=2 / n, learning_rate=0.1)
+    tr.step(jnp.zeros(()), None)
+    w = tr.leaves[0]
+    nz = np.nonzero(w)[0]
+    np.testing.assert_array_equal(nz, [3, spike])
+    np.testing.assert_allclose(w[spike], -10.0)   # -lr * 100
+    np.testing.assert_allclose(w[3], 5.0)         # -lr * -50
+
+
+def test_push_bsc_duplicate_indices_sum():
+    """A payload carrying the same index twice aggregates by SUM (the
+    documented contract; fancy-index assignment would silently drop
+    the first value)."""
+    from geomx_tpu.compression import _generic_decompress
+
+    out = _generic_decompress(
+        "bsc", np.array([1.0, 2.0, 5.0], np.float32),
+        np.array([5, 5, 0], np.int32), 8)
+    np.testing.assert_allclose(out[[0, 5]], [5.0, 3.0])
+    assert out.sum() == 8.0

@@ -1,0 +1,107 @@
+"""Transformer through HiPS + BSC device-resident (round-3 verdict #3).
+
+The flagship config must carry a real transformer, not just the demo
+CNN: at threshold=1.0 the BSC wire is lossless, so the distributed
+loss curve must match single-process SGD on the mean gradient exactly;
+at a sparse threshold the loss must still go down.
+"""
+
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+
+from examples.transformer_bsc_device import (  # noqa: E402
+    build_transformer_grad_step, synth_batch)
+from geomx_tpu.simulate import InProcessHiPS  # noqa: E402
+from geomx_tpu.trainer_device import DeviceResidentTrainer  # noqa: E402
+
+DIMS = dict(dim=32, depth=1, heads=2, vocab=64, seq_len=16)
+ROUNDS = 8
+LR = 0.1
+
+
+def _batches(widx, n):
+    rng = np.random.default_rng(100 + widx)
+    return [jnp.asarray(synth_batch(rng, 4, DIMS["seq_len"],
+                                    DIMS["vocab"])) for _ in range(n)]
+
+
+def _run_distributed(threshold, momentum=0.0):
+    topo = InProcessHiPS(num_parties=2, workers_per_party=1).start()
+    losses = {}
+    errs = []
+    try:
+        leaves0, grad_step = build_transformer_grad_step(
+            **DIMS, compute_dtype=jnp.float32)
+
+        def master_init(kv):
+            for i, leaf in enumerate(leaves0):
+                kv.init(i, leaf)
+            kv.wait()
+
+        def worker(kv):
+            widx = 0 if kv is topo.workers[0] else 1
+            _, gs = build_transformer_grad_step(
+                **DIMS, compute_dtype=jnp.float32)
+            tr = DeviceResidentTrainer(
+                list(leaves0), kv, gs, threshold=threshold,
+                learning_rate=LR, momentum=momentum)
+            curve = []
+            for toks in _batches(widx, ROUNDS):
+                curve.append(tr.step(toks, None))
+            losses[widx] = curve
+
+        def run():
+            try:
+                topo.run_workers(worker, include_master=master_init,
+                                 timeout=600)
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        t = threading.Thread(target=run)
+        t.start()
+        t.join(600)
+        assert not t.is_alive(), "workers hung"
+        if errs:
+            raise errs[0]
+    finally:
+        topo.stop()
+    return losses
+
+
+def test_lossless_threshold_matches_mean_grad_sgd():
+    """threshold=1.0: the distributed per-worker loss curves must equal
+    a single-process simulation stepping on the MEAN of the two
+    workers' gradients (what HiPS aggregation computes)."""
+    dist = _run_distributed(threshold=1.0)
+
+    leaves, grad_step = build_transformer_grad_step(
+        **DIMS, compute_dtype=jnp.float32)
+    lv = [jnp.asarray(l) for l in leaves]
+    b0, b1 = _batches(0, ROUNDS), _batches(1, ROUNDS)
+    expect0, expect1 = [], []
+    for toks0, toks1 in zip(b0, b1):
+        l0, g0 = grad_step(lv, toks0, None)
+        l1, g1 = grad_step(lv, toks1, None)
+        expect0.append(float(l0))
+        expect1.append(float(l1))
+        lv = [w - LR * (a + b) / 2 for w, a, b in zip(lv, g0, g1)]
+
+    np.testing.assert_allclose(dist[0], expect0, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(dist[1], expect1, rtol=2e-4, atol=2e-4)
+
+
+def test_sparse_threshold_learns():
+    """threshold=0.05 (per-tensor top-k): loss must fall on both
+    workers — sparsification slows but does not break learning."""
+    dist = _run_distributed(threshold=0.05, momentum=0.9)
+    for widx in (0, 1):
+        curve = dist[widx]
+        assert min(curve[-3:]) < curve[0], curve
