@@ -562,22 +562,9 @@ def bench_transformer_bsc(threshold: float = 0.01, rounds: int = 30,
             curves[widx] = curve
             times[widx] = time.perf_counter() - t0
 
-        errs: list = []
-
-        def run():
-            try:
-                topo.run_workers(worker, include_master=master_init,
-                                 timeout=1800)
-            except BaseException as e:  # noqa: BLE001
-                errs.append(e)
-
-        t = threading.Thread(target=run, daemon=True)
-        t.start()
-        t.join(1800)
-        if t.is_alive():
-            raise TimeoutError("transformer BSC phase hung")
-        if errs:
-            raise errs[0]
+        # run_workers joins with a timeout, surfaces worker errors, and
+        # raises on hang
+        topo.run_workers(worker, include_master=master_init, timeout=1800)
         wall = max(times.values())
         tok_s = rounds * B * T * 2 / wall
         c0 = curves[0]

@@ -81,9 +81,10 @@ def main():
     ap.add_argument("-cr", "--compression-ratio", type=float, default=0.01,
                     help="BSC threshold: per-tensor top-k keeps this "
                          "fraction of coordinates")
-    ap.add_argument("-ds", "--data-slice-idx", type=int, default=0,
+    ap.add_argument("-ds", "--data-slice-idx", type=int, default=None,
                     help="worker slice id (set by the launch scripts); "
-                         "seeds this worker's disjoint data stream")
+                         "seeds this worker's disjoint data stream; "
+                         "defaults to the kv rank when not given")
     ap.add_argument("--max-iters", type=int, default=50)
     ap.add_argument("--local", action="store_true",
                     help="single-process local kvstore (no topology)")
@@ -122,7 +123,8 @@ def main():
           f"per-round selection {tr.k} of {tr.total} "
           f"({100.0 * tr.k / tr.total:.2f}%)", flush=True)
 
-    slice_idx = args.data_slice_idx or my_rank
+    slice_idx = (my_rank if args.data_slice_idx is None
+                 else args.data_slice_idx)
     rng = np.random.default_rng(1234 + slice_idx)  # disjoint data slices
     import jax.numpy as jnp
 

@@ -220,6 +220,13 @@ def _generic_decompress(tag, val, aux, orig_len):
         vals = np.asarray(val, dtype=np.float32).ravel()
         out = np.zeros(orig_len, dtype=np.float32)
         ok = (idx >= 0) & (idx < orig_len)
+        if not ok.all():
+            import logging
+
+            logging.getLogger("geomx.compression").warning(
+                "bsc push: dropping %d out-of-range indices "
+                "(payload addresses %d elements)",
+                int((~ok).sum()), orig_len)
         np.add.at(out, idx[ok], vals[ok])
         return out
     if tag == "2bit":

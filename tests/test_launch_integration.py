@@ -29,8 +29,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run_launch(script: str, extra_args, n_iters: int, timeout: float,
-                expect_lines: int = 0):
+                expect_lines: int = 0, env_extra=None):
     env = dict(os.environ)
+    if env_extra:
+        env.update(env_extra)
     env.update({
         "GPORT": str(_free_port()), "CPORT": str(_free_port()),
         "APORT": str(_free_port()), "BPORT": str(_free_port()),
@@ -73,6 +75,7 @@ def _run_launch(script: str, extra_args, n_iters: int, timeout: float,
     return accs
 
 
+@pytest.mark.slow
 def test_vanilla_hips_subprocess_topology():
     accs = _run_launch("run_vanilla_hips.sh", [], n_iters=15, timeout=240)
     # the correctness signal: training must actually learn (random = 0.1)
@@ -80,6 +83,7 @@ def test_vanilla_hips_subprocess_topology():
     assert max(accs[-5:]) > accs[0], f"accuracy did not improve: {accs}"
 
 
+@pytest.mark.slow
 def test_bsc_subprocess_topology():
     """The BASELINE headline config through the REAL launch chain:
     cnn_bsc.py (aggregator PS, worker-side Adam, BSC both directions).
@@ -102,6 +106,7 @@ def test_bsc_subprocess_topology():
 
 
 
+@pytest.mark.slow
 def test_mixed_sync_subprocess_topology():
     """MixedSync (dist_async: per-push global updates, no global
     barrier) through the real launch chain. Deterministic across runs
@@ -111,6 +116,7 @@ def test_mixed_sync_subprocess_topology():
     assert max(accs[-5:]) > accs[0], f"no improvement: {accs}"
 
 
+@pytest.mark.slow
 def test_hfa_subprocess_topology():
     """HFA (K1 local steps per LAN sync, K2-periodic WAN rounds)
     through the real launch chain; prints every K1=2 iterations.
@@ -120,6 +126,7 @@ def test_hfa_subprocess_topology():
     assert max(accs[-4:]) > 0.5, f"HFA did not learn: {accs}"
 
 
+@pytest.mark.slow
 def test_fp16_subprocess_topology():
     """FP16 wire transmission through the real launch chain
     (deterministic: calibration trials identical, 0.6934 @ 15)."""
@@ -127,12 +134,84 @@ def test_fp16_subprocess_topology():
     assert max(accs[-5:]) > 0.5, f"FP16 did not learn: {accs}"
 
 
+@pytest.mark.slow
 def test_mpq_subprocess_topology():
     """MPQ (size-threshold fp16/bsc routing) through the real launch
     chain (near-deterministic: 0.775-0.782 @ 25 across trials; the BSC
     component adds slight variance)."""
     accs = _run_launch("run_mpq.sh", [], n_iters=25, timeout=300)
     assert max(accs[-8:]) > 0.5, f"MPQ did not learn: {accs}"
+
+
+# ---------------------------------------------------------------------------
+# round-4: the remaining 6 feature scripts (round-3 verdict item 5 —
+# DGT, P3, TS pair, MultiGPS, DCASGD had only in-process coverage; the
+# round-1 regression shipped through exactly this untested env-var ->
+# bootstrap -> subprocess glue). Marked slow: the default CI tier runs
+# `pytest -m "not slow"`; these belong to the nightly/full tier.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_p3_subprocess_topology():
+    """P3 priority scheduling (ENABLE_P3=1: bigarray-granularity key
+    slicing + priority send queues) through the real launch chain."""
+    accs = _run_launch("run_p3.sh", [], n_iters=15, timeout=300)
+    assert max(accs[-5:]) > 0.4, f"P3 did not learn: {accs}"
+    assert max(accs[-5:]) > accs[0], f"no improvement: {accs}"
+
+
+@pytest.mark.slow
+def test_multi_gps_subprocess_topology():
+    """MultiGPS (DMLC_NUM_GLOBAL_SERVER=2): 13 processes, keys shard
+    across two global servers by the canonical heuristic."""
+    accs = _run_launch("run_multi_gps.sh", [], n_iters=15, timeout=300)
+    assert max(accs[-5:]) > 0.4, f"MultiGPS did not learn: {accs}"
+    assert max(accs[-5:]) > accs[0], f"no improvement: {accs}"
+
+
+@pytest.mark.slow
+def test_dcasgd_subprocess_topology():
+    """DCASGD (dist_async + delay compensation at the global tier)
+    through the real launch chain. Async trajectories are noisy —
+    the bar is leaving chance decisively, not a fixed curve."""
+    accs = _run_launch("run_dcasgd.sh", ["-lr", "0.05"], n_iters=60,
+                       timeout=420)
+    assert max(accs) > 0.3, f"DCASGD did not learn: {accs}"
+    assert len(set(accs[-20:])) > 3, f"accuracy never moved: {accs}"
+
+
+@pytest.mark.slow
+def test_dgt_udp_subprocess_topology():
+    """DGT mode 1: unimportant gradient blocks ride lossy UDP channels
+    on the inter-DC tier (ENABLE_DGT=1, DMLC_UDP_CHANNEL_NUM=3)."""
+    accs = _run_launch("run_dgt.sh", [], n_iters=20, timeout=300,
+                       env_extra={"ENABLE_DGT": "1"})
+    assert max(accs[-5:]) > 0.3, f"DGT/UDP did not learn: {accs}"
+
+
+@pytest.mark.slow
+def test_dgt_quantized_subprocess_topology():
+    """DGT mode 3: unimportant blocks 4-bit quantized over TCP."""
+    accs = _run_launch("run_dgt.sh", [], n_iters=20, timeout=300,
+                       env_extra={"ENABLE_DGT": "3"})
+    assert max(accs[-5:]) > 0.3, f"DGT/quantized did not learn: {accs}"
+
+
+@pytest.mark.slow
+def test_intra_ts_subprocess_topology():
+    """Intra-DC TSEngine: worker-to-worker merge overlays built by the
+    party scheduler (ENABLE_INTRA_TS=1)."""
+    accs = _run_launch("run_intra_ts.sh", [], n_iters=15, timeout=300)
+    assert max(accs[-5:]) > 0.3, f"intra-TS did not learn: {accs}"
+
+
+@pytest.mark.slow
+def test_inter_ts_subprocess_topology():
+    """Inter-DC TSEngine: party-to-party aggregate merge on the WAN
+    tier (ENABLE_INTER_TS=1)."""
+    accs = _run_launch("run_inter_ts.sh", [], n_iters=15, timeout=300)
+    assert max(accs[-5:]) > 0.3, f"inter-TS did not learn: {accs}"
 
 
 if __name__ == "__main__":

@@ -13,6 +13,8 @@ import pytest
 
 from geomx_tpu.models import get_model
 
+pytestmark = pytest.mark.slow  # compile-heavy: nightly tier
+
 RNG = jax.random.PRNGKey(0)
 
 

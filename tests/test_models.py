@@ -8,6 +8,8 @@ import pytest
 from geomx_tpu.models import MLP, create_cnn, create_resnet
 from geomx_tpu.models.transformer import Transformer
 
+pytestmark = pytest.mark.slow  # compile-heavy: nightly tier
+
 
 def test_cnn_shapes():
     m = create_cnn()

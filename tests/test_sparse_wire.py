@@ -7,8 +7,6 @@ aggregated gradient's exact nonzero set. Semantics must equal a dense
 push of the scattered selection.
 """
 
-import threading
-
 import numpy as np
 import pytest
 
@@ -16,21 +14,10 @@ from geomx_tpu.simulate import InProcessHiPS
 
 
 def _run_workers(topo, worker_fn, master_init, timeout=300):
-    errs = []
-
-    def run():
-        try:
-            topo.run_workers(worker_fn, include_master=master_init,
-                             timeout=timeout)
-        except BaseException as e:  # noqa: BLE001
-            errs.append(e)
-
-    t = threading.Thread(target=run)
-    t.start()
-    t.join(timeout)
-    assert not t.is_alive(), "workers hung"
-    if errs:
-        raise errs[0]
+    # run_workers joins with a timeout, surfaces worker errors, and
+    # raises on hang — no wrapper thread needed
+    topo.run_workers(worker_fn, include_master=master_init,
+                     timeout=timeout)
 
 
 @pytest.mark.parametrize("sharded", [False, True])
