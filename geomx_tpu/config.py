@@ -31,6 +31,27 @@ def env_float(name: str, default: float = 0.0) -> float:
     return float(v)
 
 
+def resolve_interface_ip(ifname: str) -> str:
+    """IPv4 address of a named NIC (reference: van.cc GetIP — the
+    getifaddrs walk; here the Linux SIOCGIFADDR ioctl, no deps)."""
+    import fcntl
+    import socket
+    import struct
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        packed = fcntl.ioctl(
+            s.fileno(), 0x8915,  # SIOCGIFADDR
+            struct.pack("256s", ifname[:15].encode()))
+        return socket.inet_ntoa(packed[20:24])
+    except OSError as e:
+        raise ValueError(
+            f"DMLC_INTERFACE={ifname!r}: cannot resolve an IPv4 address "
+            f"({e})") from e
+    finally:
+        s.close()
+
+
 def env_bool(name: str, default: bool = False) -> bool:
     v = os.environ.get(name)
     if v is None or v == "":
@@ -82,6 +103,24 @@ class Config:
     interface: str = ""                 # DMLC_INTERFACE
     node_host: str = ""                 # DMLC_NODE_HOST
     node_port: int = 0                  # PORT (0 = ephemeral)
+
+    def node_addr(self) -> "tuple[str, str]":
+        """(bind_host, advertise_host) for this node's van.
+
+        Reference semantics (van.cc:427-477 GetIP/GetInterfaceAndIP):
+        DMLC_NODE_HOST names the address peers should dial — the van
+        binds every interface (0.0.0.0) and advertises it; otherwise
+        DMLC_INTERFACE names a NIC whose address is resolved and used
+        for both; with neither, loopback (the reference falls back to
+        the default-route interface — a single-host default here, where
+        tests must not accidentally listen on external interfaces).
+        """
+        if self.node_host:
+            return "0.0.0.0", self.node_host
+        if self.interface:
+            ip = resolve_interface_ip(self.interface)
+            return ip, ip
+        return "127.0.0.1", "127.0.0.1"
 
     # ---- feature toggles (reference: van.cc:539-549, 613-629) ----
     enable_p3: bool = False             # ENABLE_P3

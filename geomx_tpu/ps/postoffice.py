@@ -41,6 +41,7 @@ class Postoffice:
         self.my_role = my_role
         self.num_workers = num_workers
         self.num_servers = num_servers
+        _bind_host, _advertise_host = cfg.node_addr()
         self.van = Van(
             my_role=my_role,
             is_global=is_global,
@@ -48,7 +49,8 @@ class Postoffice:
             root_port=root_port,
             num_workers=num_workers,
             num_servers=num_servers,
-            bind_host=cfg.node_host or "127.0.0.1",
+            bind_host=_bind_host,
+            advertise_host=_advertise_host,
             drop_rate=cfg.drop_rate,
             resend_timeout_s=(cfg.resend_timeout_ms / 1000.0
                               if cfg.resend else 0.0),

@@ -56,6 +56,7 @@ class Van:
         num_workers: int,
         num_servers: int,
         bind_host: str = "127.0.0.1",
+        advertise_host: str = "",
         drop_rate: float = 0.0,
         resend_timeout_s: float = 0.0,
         heartbeat_interval_s: float = 0.0,
@@ -71,6 +72,15 @@ class Van:
         self.num_workers = num_workers
         self.num_servers = num_servers
         self.bind_host = bind_host
+        # the address peers DIAL (put into the broadcast node table) —
+        # distinct from bind_host so a van can listen on every interface
+        # (0.0.0.0) while advertising its DMLC_NODE_HOST (reference:
+        # van.cc:427-477 Node.hostname from DMLC_NODE_HOST/interface IP)
+        self.advertise_host = advertise_host or bind_host
+        if self.advertise_host in ("0.0.0.0", ""):
+            raise ValueError(
+                "a van bound to 0.0.0.0 needs an explicit advertise "
+                "address (DMLC_NODE_HOST) — peers cannot dial 0.0.0.0")
         self.drop_rate = drop_rate
         self.resend_timeout_s = resend_timeout_s
         # ACK/retransmit layer (reference: resender.h, PS_RESEND)
@@ -168,7 +178,8 @@ class Van:
             self._spawn(self._priority_send_loop, "van-psend")
         if self.is_scheduler:
             self.my_id = base.SCHEDULER
-            self.node_table[base.SCHEDULER] = (self.bind_host, self.root_port)
+            self.node_table[base.SCHEDULER] = (self.advertise_host,
+                                               self.root_port)
             self.node_roles[base.SCHEDULER] = Role.SCHEDULER
             # scheduler is ready once every node has registered; barrier-less
             # callers may proceed as soon as the table is broadcast
@@ -328,7 +339,7 @@ class Van:
         """Send ADD_NODE to the scheduler (reference: van.cc:509-516)."""
         node = Node(
             role=self.my_role,
-            hostname=self.bind_host,
+            hostname=self.advertise_host,
             port=self.my_port,
             udp_ports=list(self.udp_ports),
             sort_key=getattr(self, "sort_key", -1),
@@ -647,7 +658,7 @@ class Van:
                 if n.udp_ports:
                     self._node_udp[n.id] = list(n.udp_ports)
                 if (
-                    n.hostname == self.bind_host
+                    n.hostname == self.advertise_host
                     and n.port == self.my_port
                     and n.role == self.my_role
                 ):
@@ -703,7 +714,7 @@ class Van:
                 Node(
                     role=Role.SCHEDULER,
                     id=base.SCHEDULER,
-                    hostname=self.bind_host,
+                    hostname=self.advertise_host,
                     port=self.root_port,
                 )
             ]

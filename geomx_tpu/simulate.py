@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 from typing import Callable, List, Optional
 
 from geomx_tpu.config import Config
@@ -217,10 +218,13 @@ class InProcessHiPS:
             fns.append((include_master, self.master))
         ts = [threading.Thread(target=wrap, args=(f, *a), daemon=True)
               for f, *a in fns]
+        deadline = time.monotonic() + timeout
         for t in ts:
             t.start()
         for t in ts:
-            t.join(timeout)
+            # one SHARED deadline: sequential joins must not stack into
+            # N x timeout when several workers hang
+            t.join(max(deadline - time.monotonic(), 0.0))
         if errs:
             raise errs[0]
         hung = sum(t.is_alive() for t in ts)

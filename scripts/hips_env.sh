@@ -4,6 +4,12 @@
 # global scheduler + global server + master worker + scheduler; two data
 # parties with scheduler + server + 2 workers each).
 # Usage: source hips_env.sh; launch_hips <worker_script> [extra args...]
+#
+# Multi-host simulation (reference: docs/source/multi-host-deployment.rst):
+# set HOST_CENTRAL / HOST_A / HOST_B to distinct addresses and each
+# party's nodes bind 0.0.0.0 and ADVERTISE that address via
+# DMLC_NODE_HOST — the same wiring a real deployment uses with one
+# address per machine. Defaults keep everything on plain loopback.
 
 REPO_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 export PYTHONPATH="$REPO_DIR${PYTHONPATH:+:$PYTHONPATH}"
@@ -13,8 +19,16 @@ INFRA="-c \"import geomx_tpu\""
 # NGS>1 = MultiGPS: several global servers share the central party
 # (reference: scripts/cpu/run_multi_gps.sh, DMLC_NUM_GLOBAL_SERVER=2)
 NGS=${NGS:-1}
+HOST_CENTRAL=${HOST_CENTRAL:-127.0.0.1}
+HOST_A=${HOST_A:-127.0.0.1}
+HOST_B=${HOST_B:-127.0.0.1}
+# advertise only when off plain loopback, so the default single-host
+# demo keeps listening on 127.0.0.1 alone
+NH_CENTRAL=$([ "$HOST_CENTRAL" = "127.0.0.1" ] || echo "DMLC_NODE_HOST=$HOST_CENTRAL")
+NH_A=$([ "$HOST_A" = "127.0.0.1" ] || echo "DMLC_NODE_HOST=$HOST_A")
+NH_B=$([ "$HOST_B" = "127.0.0.1" ] || echo "DMLC_NODE_HOST=$HOST_B")
 
-GLOBALS="DMLC_PS_GLOBAL_ROOT_URI=127.0.0.1 DMLC_PS_GLOBAL_ROOT_PORT=$GPORT \
+GLOBALS="DMLC_PS_GLOBAL_ROOT_URI=$HOST_CENTRAL DMLC_PS_GLOBAL_ROOT_PORT=$GPORT \
 DMLC_NUM_GLOBAL_SERVER=$NGS DMLC_NUM_GLOBAL_WORKER=2"
 
 launch_hips() {
@@ -22,41 +36,43 @@ launch_hips() {
   local extra="$@"
 
   # central party -----------------------------------------------------
-  env $(echo $GLOBALS) DMLC_ROLE_GLOBAL=global_scheduler \
+  env $(echo $GLOBALS) $NH_CENTRAL DMLC_ROLE_GLOBAL=global_scheduler \
     $PYTHON -c "import geomx_tpu" > /tmp/hips_gsched.log 2>&1 &
-  env DMLC_ROLE=scheduler DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$CPORT \
+  env $NH_CENTRAL DMLC_ROLE=scheduler DMLC_PS_ROOT_URI=$HOST_CENTRAL DMLC_PS_ROOT_PORT=$CPORT \
     DMLC_NUM_SERVER=$NGS DMLC_NUM_WORKER=1 \
     $PYTHON -c "import geomx_tpu" > /tmp/hips_csched.log 2>&1 &
   for g in $(seq 1 $NGS); do
-    env $(echo $GLOBALS) DMLC_ROLE_GLOBAL=global_server DMLC_ROLE=server \
-      DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$CPORT \
+    env $(echo $GLOBALS) $NH_CENTRAL DMLC_ROLE_GLOBAL=global_server DMLC_ROLE=server \
+      DMLC_PS_ROOT_URI=$HOST_CENTRAL DMLC_PS_ROOT_PORT=$CPORT \
       DMLC_NUM_SERVER=$NGS DMLC_NUM_WORKER=1 DMLC_ENABLE_CENTRAL_WORKER=0 \
       DMLC_NUM_ALL_WORKER=4 \
       $PYTHON -c "import geomx_tpu" > /tmp/hips_gserver$g.log 2>&1 &
   done
-  env DMLC_ROLE=worker DMLC_ROLE_MASTER_WORKER=1 \
-    DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$CPORT \
+  env $NH_CENTRAL DMLC_ROLE=worker DMLC_ROLE_MASTER_WORKER=1 \
+    DMLC_PS_ROOT_URI=$HOST_CENTRAL DMLC_PS_ROOT_PORT=$CPORT \
     DMLC_NUM_SERVER=$NGS DMLC_NUM_WORKER=1 DMLC_NUM_ALL_WORKER=4 \
     $PYTHON $script $extra > /tmp/hips_master.log 2>&1 &
 
   # data parties ------------------------------------------------------
   local slice=0
+  local PHOST NH_P
   for PPORT in $APORT $BPORT; do
-    env DMLC_ROLE=scheduler DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$PPORT \
+    if [ "$PPORT" = "$APORT" ]; then PHOST=$HOST_A; NH_P=$NH_A; else PHOST=$HOST_B; NH_P=$NH_B; fi
+    env $NH_P DMLC_ROLE=scheduler DMLC_PS_ROOT_URI=$PHOST DMLC_PS_ROOT_PORT=$PPORT \
       DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=2 \
       $PYTHON -c "import geomx_tpu" > /tmp/hips_sched_$PPORT.log 2>&1 &
-    env $(echo $GLOBALS) DMLC_ROLE=server \
-      DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$PPORT \
+    env $(echo $GLOBALS) $NH_P DMLC_ROLE=server \
+      DMLC_PS_ROOT_URI=$PHOST DMLC_PS_ROOT_PORT=$PPORT \
       DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=2 \
       $PYTHON -c "import geomx_tpu" > /tmp/hips_server_$PPORT.log 2>&1 &
     for w in 0 1; do
       if [ "$PPORT" = "$BPORT" ] && [ "$w" = "1" ]; then
         # last worker runs in the foreground (reference pattern)
-        env DMLC_ROLE=worker DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$PPORT \
+        env $NH_P DMLC_ROLE=worker DMLC_PS_ROOT_URI=$PHOST DMLC_PS_ROOT_PORT=$PPORT \
           DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=2 DMLC_NUM_ALL_WORKER=4 \
           $PYTHON -u $script --data-slice-idx $slice $extra
       else
-        env DMLC_ROLE=worker DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$PPORT \
+        env $NH_P DMLC_ROLE=worker DMLC_PS_ROOT_URI=$PHOST DMLC_PS_ROOT_PORT=$PPORT \
           DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=2 DMLC_NUM_ALL_WORKER=4 \
           $PYTHON $script --data-slice-idx $slice $extra > /tmp/hips_w$slice.log 2>&1 &
       fi
