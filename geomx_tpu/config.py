@@ -109,14 +109,26 @@ class Config:
 
         Reference semantics (van.cc:427-477 GetIP/GetInterfaceAndIP):
         DMLC_NODE_HOST names the address peers should dial — the van
-        binds every interface (0.0.0.0) and advertises it; otherwise
+        binds it directly when it is a local address (the reference
+        binds the resolved address, not a wildcard) and falls back to
+        0.0.0.0 only when it is not locally bindable (NAT/VIP: the
+        advertised address lives on a middlebox); otherwise
         DMLC_INTERFACE names a NIC whose address is resolved and used
         for both; with neither, loopback (the reference falls back to
         the default-route interface — a single-host default here, where
         tests must not accidentally listen on external interfaces).
         """
         if self.node_host:
-            return "0.0.0.0", self.node_host
+            import socket
+
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            try:
+                s.bind((self.node_host, 0))
+                return self.node_host, self.node_host
+            except OSError:
+                return "0.0.0.0", self.node_host
+            finally:
+                s.close()
         if self.interface:
             ip = resolve_interface_ip(self.interface)
             return ip, ip

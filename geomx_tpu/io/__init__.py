@@ -6,3 +6,5 @@ from geomx_tpu.io.iterators import (  # noqa: F401
 from geomx_tpu.io.recordio import (  # noqa: F401
     ImageRecordIter, IRHeader, MXRecordIO, pack, pack_array, unpack,
     unpack_array)
+from geomx_tpu.io.image import (  # noqa: F401
+    ImageAugmenter, imdecode, imencode, pack_img, unpack_img)

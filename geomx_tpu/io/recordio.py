@@ -7,11 +7,11 @@ Wire-compatible with the reference's RecordIO framing
 flag, float label, id, id2; ``flag > 0`` means flag extra float32
 labels follow the header).
 
-``ImageRecordIter`` (reference: src/io/iter_image_recordio_2.cc) here
-iterates packs whose payloads are RAW uint8 arrays of a fixed
-``data_shape`` — JPEG decode is deliberately out of scope (no image
-codec in the dependency set); ``pack_array``/``unpack_array`` are the
-raw-payload counterparts of mx.recordio.pack_img/unpack_img.
+``ImageRecordIter`` (reference: src/io/iter_image_recordio_2.cc)
+iterates packs whose payloads are either encoded images (JPEG/PNG via
+``geomx_tpu.io.image.pack_img``, decoded + augmented on the fly) or
+RAW uint8 arrays of a fixed ``data_shape``
+(``pack_array``/``unpack_array``, codec-free).
 """
 
 from __future__ import annotations
@@ -124,44 +124,94 @@ class MXRecordIO:
 
 
 class ImageRecordIter:
-    """Batched iterator over a raw-payload RecordIO pack
-    (reference: iter_image_recordio_2.cc, minus JPEG decode).
+    """Batched iterator over a RecordIO pack (reference:
+    iter_image_recordio_2.cc).
 
-    Yields ``(data [B,*data_shape] float32 in [0,1], label [B])``; the
-    tail batch pads from the file head (reference round_batch
-    behavior).
+    Payloads are detected per record: JPEG/PNG bodies (``pack_img``) are
+    decoded on the fly — the compressed bytes stay in memory, pixels are
+    materialized per batch, and an optional ``aug``
+    (:class:`geomx_tpu.io.image.ImageAugmenter`) runs per sample per
+    epoch, exactly the reference parser's decode->augment stage; raw
+    uint8 bodies (``pack_array``) are decoded once up front. Wrap in
+    ``PrefetchIter`` for the thread overlap the reference gets from
+    ``preprocess_threads``.
+
+    Yields ``(data [B,*data_shape] float32, label [B])``; without an
+    augmenter pixels are scaled to [0,1]. The tail batch pads from the
+    file head (reference round_batch behavior).
     """
 
     def __init__(self, path_imgrec: str, data_shape: Sequence[int],
-                 batch_size: int, shuffle: bool = False, seed: int = 0):
+                 batch_size: int, shuffle: bool = False, seed: int = 0,
+                 aug=None):
         self.data_shape = tuple(data_shape)
         self.batch_size = batch_size
         self.shuffle = shuffle
+        self.aug = aug
         self._rng = np.random.RandomState(seed)
+        self._encoded: List[bytes] = []
         imgs: List[np.ndarray] = []
         labels: List[float] = []
+        from geomx_tpu.io.image import is_encoded_image
+
+        raw_len = int(np.prod(self.data_shape))
         with MXRecordIO(path_imgrec, "r") as rec:
             while True:
                 raw = rec.read()
                 if raw is None:
                     break
-                header, arr = unpack_array(raw, self.data_shape)
+                header, body = unpack(raw)
                 lab = header.label
                 labels.append(float(np.asarray(lab).ravel()[0]))
-                imgs.append(arr)
+                # deterministic classification: a raw payload is always
+                # exactly prod(data_shape) bytes (an encoded body
+                # essentially never is) — size decides, the image magic
+                # only validates; a 2-byte sniff alone would misread a
+                # raw pack whose first pixel is (255, 216, ...)
+                if len(body) == raw_len:
+                    imgs.append(np.frombuffer(body, np.uint8)
+                                .reshape(self.data_shape))
+                elif is_encoded_image(body):
+                    self._encoded.append(body)
+                else:
+                    raise ValueError(
+                        f"{path_imgrec}: record {len(labels) - 1} is "
+                        f"neither a raw array of {raw_len} bytes nor an "
+                        "encoded JPEG/PNG")
+        if self._encoded and imgs:
+            raise ValueError(f"{path_imgrec} mixes encoded and raw "
+                             "payloads")
         self.data = (np.stack(imgs).astype(np.float32) / 255.0
                      if imgs else
                      np.zeros((0, *self.data_shape), np.float32))
         self.label = np.asarray(labels, np.float32)
 
+    def _materialize(self, i: int) -> np.ndarray:
+        """Decode (+augment) one encoded sample -> float32 data_shape."""
+        from geomx_tpu.io.image import imdecode
+
+        arr = imdecode(self._encoded[i])
+        if self.aug is not None:
+            out = self.aug(arr)
+        else:
+            out = arr.astype(np.float32) / 255.0
+            if out.ndim == 2:
+                out = out[..., None]
+        if out.shape != self.data_shape:
+            raise ValueError(
+                f"decoded sample shape {out.shape} != data_shape "
+                f"{self.data_shape}; add resize/crop via aug=")
+        return out
+
     def reset(self) -> None:
         pass
 
     def __len__(self) -> int:
-        return -(-len(self.data) // self.batch_size)
+        n = len(self._encoded) or len(self.data)
+        return -(-n // self.batch_size)
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        n = len(self.data)
+        n = len(self._encoded) or len(self.data)
         if n == 0:
             return
         idx = np.arange(n)
@@ -172,4 +222,8 @@ class ImageRecordIter:
             sel = idx[i * bs:(i + 1) * bs]
             if len(sel) < bs:  # pad from head (round_batch)
                 sel = np.concatenate([sel, idx[:bs - len(sel)]])
-            yield self.data[sel], self.label[sel]
+            if self._encoded:
+                yield (np.stack([self._materialize(j) for j in sel]),
+                       self.label[sel])
+            else:
+                yield self.data[sel], self.label[sel]

@@ -1,0 +1,177 @@
+"""JPEG/PNG ImageRecordIO + augmentation (round-3 verdict item 7).
+
+Reference: src/io/iter_image_recordio_2.cc (decode-from-record),
+image_aug_default.cc (default augmenter), iter_normalize.h
+(scale/mean/std), python/mxnet/recordio.py pack_img/unpack_img.
+"""
+
+import numpy as np
+import pytest
+
+from geomx_tpu.io import (ImageAugmenter, ImageRecordIter, IRHeader,
+                          MXRecordIO, PrefetchIter, imdecode, imencode,
+                          pack_array, pack_img, unpack_img)
+
+
+def _imgs(n, h=32, w=32, c=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, 256, (h, w, c), np.uint8) for _ in range(n)]
+
+
+def test_png_pack_img_round_trip_exact():
+    img = _imgs(1)[0]
+    rec = pack_img(IRHeader(0, 3.0, 7, 0), img, img_fmt=".png")
+    header, back = unpack_img(rec)
+    assert header.label == 3.0 and header.id == 7
+    np.testing.assert_array_equal(back, img)
+
+
+def test_jpeg_pack_img_round_trip_close():
+    # smooth gradient: JPEG is lossy but must stay close
+    y, x = np.mgrid[0:64, 0:64]
+    img = np.stack([x * 4, y * 4, (x + y) * 2], -1).astype(np.uint8)
+    header, back = unpack_img(pack_img(IRHeader(0, 1.0, 0, 0), img,
+                                       quality=95))
+    assert back.shape == img.shape
+    assert np.abs(back.astype(int) - img.astype(int)).mean() < 4.0
+
+
+def test_unpack_img_rejects_raw_payload():
+    rec = pack_array(IRHeader(0, 1.0, 0, 0), _imgs(1)[0])
+    with pytest.raises(ValueError, match="not a JPEG/PNG"):
+        unpack_img(rec)
+
+
+def test_encoded_iter_matches_raw_iter(tmp_path):
+    """Property test vs the raw-array path: the same pixels packed as
+    PNG (lossless) and as raw arrays must iterate identically."""
+    imgs = _imgs(10)
+    p_raw, p_png = str(tmp_path / "raw.rec"), str(tmp_path / "png.rec")
+    with MXRecordIO(p_raw, "w") as w_raw, MXRecordIO(p_png, "w") as w_png:
+        for i, img in enumerate(imgs):
+            hdr = IRHeader(0, float(i % 3), i, 0)
+            w_raw.write(pack_array(hdr, img))
+            w_png.write(pack_img(hdr, img, img_fmt=".png"))
+    it_raw = ImageRecordIter(p_raw, (32, 32, 3), batch_size=4)
+    it_png = ImageRecordIter(p_png, (32, 32, 3), batch_size=4)
+    assert len(it_raw) == len(it_png) == 3
+    for (xr, yr), (xp, yp) in zip(it_raw, it_png):
+        np.testing.assert_allclose(xr, xp)
+        np.testing.assert_array_equal(yr, yp)
+
+
+def test_jpeg_iter_decodes_on_the_fly(tmp_path):
+    p = str(tmp_path / "jpg.rec")
+    imgs = _imgs(6, h=40, w=48)
+    with MXRecordIO(p, "w") as w:
+        for i, img in enumerate(imgs):
+            w.write(pack_img(IRHeader(0, float(i), i, 0), img,
+                             img_fmt=".jpg"))
+    aug = ImageAugmenter((32, 32, 3), rand_crop=True, rand_mirror=True,
+                         seed=3)
+    it = ImageRecordIter(p, (32, 32, 3), batch_size=2, aug=aug)
+    batches = list(it)
+    assert len(batches) == 3
+    for X, y in batches:
+        assert X.shape == (2, 32, 32, 3) and X.dtype == np.float32
+    # epochs re-augment: random crops differ across epochs
+    again = list(it)
+    assert not all(np.array_equal(a[0], b[0])
+                   for a, b in zip(batches, again))
+
+
+def test_iter_rejects_mixed_payloads(tmp_path):
+    p = str(tmp_path / "mixed.rec")
+    img = _imgs(1)[0]
+    with MXRecordIO(p, "w") as w:
+        w.write(pack_array(IRHeader(0, 0.0, 0, 0), img))
+        w.write(pack_img(IRHeader(0, 1.0, 1, 0), img, img_fmt=".png"))
+    with pytest.raises(ValueError, match="mixes"):
+        ImageRecordIter(p, (32, 32, 3), batch_size=1)
+
+
+def test_augmenter_ops():
+    img = _imgs(1, h=64, w=80)[0]
+    # center crop, deterministic
+    aug = ImageAugmenter((32, 32, 3))
+    out = aug(img)
+    assert out.shape == (32, 32, 3)
+    np.testing.assert_allclose(
+        out, img[16:48, 24:56].astype(np.float32) / 255.0)
+    # resize path: shorter side to 36 then crop
+    out = ImageAugmenter((32, 32, 3), resize=36)(img)
+    assert out.shape == (32, 32, 3)
+    # mean/std normalization (iter_normalize.h semantics)
+    aug = ImageAugmenter((64, 80, 3), mean_rgb=[0.5, 0.5, 0.5],
+                         std_rgb=[0.25, 0.25, 0.25])
+    out = aug(img)
+    expect = (img.astype(np.float32) / 255.0 - 0.5) / 0.25
+    np.testing.assert_allclose(out, expect, atol=1e-5)
+    # grayscale output
+    out = ImageAugmenter((64, 80, 1))(img)
+    assert out.shape == (64, 80, 1)
+    # color jitter stays in range and changes pixels
+    aug = ImageAugmenter((64, 80, 3), brightness=0.5, contrast=0.5,
+                         saturation=0.5, seed=1)
+    out = aug(img)
+    assert out.shape == (64, 80, 3)
+    assert not np.allclose(out, img.astype(np.float32) / 255.0)
+
+
+def test_prefetch_composes(tmp_path):
+    p = str(tmp_path / "pf.rec")
+    with MXRecordIO(p, "w") as w:
+        for i, img in enumerate(_imgs(8)):
+            w.write(pack_img(IRHeader(0, float(i), i, 0), img,
+                             img_fmt=".png"))
+    base = ImageRecordIter(p, (32, 32, 3), batch_size=4)
+    direct = list(base)
+    pre = list(PrefetchIter(
+        ImageRecordIter(p, (32, 32, 3), batch_size=4), prefetch=2))
+    assert len(direct) == len(pre)
+    for (a, la), (b, lb) in zip(direct, pre):
+        np.testing.assert_allclose(a, b)
+        np.testing.assert_array_equal(la, lb)
+
+
+@pytest.mark.slow
+def test_cifar_records_train_zoo_model(tmp_path):
+    """The verdict's 'done' bar: CIFAR-10-shaped images packed as JPEG
+    records train a zoo model through the real decode+augment
+    iterator (loss falls over a few steps)."""
+    import jax.numpy as jnp
+
+    from examples.utils import build_model_and_step
+
+    # CIFAR-shaped structured data (class = dominant channel) so a few
+    # steps show real learning signal
+    rng = np.random.RandomState(0)
+    p = str(tmp_path / "cifar.rec")
+    with MXRecordIO(p, "w") as w:
+        for i in range(96):
+            cls = i % 3
+            img = rng.randint(0, 64, (32, 32, 3), np.uint8)
+            img[..., cls] = rng.randint(160, 256, (32, 32), np.uint8)
+            w.write(pack_img(IRHeader(0, float(cls), i, 0), img,
+                             img_fmt=".jpg"))
+    aug = ImageAugmenter((32, 32, 3), rand_crop=True, rand_mirror=True,
+                         resize=34, seed=5)
+    it = ImageRecordIter(p, (32, 32, 3), batch_size=32, shuffle=True,
+                         aug=aug, seed=5)
+
+    leaves, _td, grad_step, _ev = build_model_and_step(
+        32, input_shape=(32, 32, 3), model="resnet18", num_classes=3)
+    import optax
+
+    opt = optax.adam(1e-3)
+    lv = [jnp.asarray(l) for l in leaves]
+    st = opt.init(lv)
+    losses = []
+    for _ in range(4):  # epochs over 3 batches
+        for X, y in PrefetchIter(it, prefetch=2):
+            loss, grads = grad_step(lv, jnp.asarray(X),
+                                    jnp.asarray(y.astype(np.int32)))
+            updates, st = opt.update(grads, st)
+            lv = [w + u for w, u in zip(lv, updates)]
+            losses.append(float(loss))
+    assert losses[-1] < losses[0], losses

@@ -31,9 +31,14 @@ def test_interface_resolution_unknown_raises():
 
 
 def test_node_addr_rules():
-    # DMLC_NODE_HOST: bind everything, advertise the named address
+    # DMLC_NODE_HOST not locally bindable (NAT/VIP): bind everything,
+    # advertise the named address
     assert Config(node_host="10.1.2.3").node_addr() == \
         ("0.0.0.0", "10.1.2.3")
+    # locally bindable DMLC_NODE_HOST: bind it directly (no wildcard
+    # listener on shared hosts)
+    assert Config(node_host="127.0.0.2").node_addr() == \
+        ("127.0.0.2", "127.0.0.2")
     # DMLC_INTERFACE: resolved IP both ways
     assert Config(interface="lo").node_addr() == \
         ("127.0.0.1", "127.0.0.1")
