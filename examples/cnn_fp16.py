@@ -44,6 +44,9 @@ def main():
     kv = gx.kv.create("dist_sync")
     if kv.is_master_worker:
         kv.set_optimizer(gx_opt.Adam(learning_rate=args.learning_rate))
+        # weights live on the servers as fp16: keep fp32 masters there
+        # (reference: kSetMultiPrecision, kvstore_dist_server.h:324)
+        kv.set_multi_precision()
     num_all_workers = kv.num_all_workers
     my_rank = kv.rank
     time.sleep(1)

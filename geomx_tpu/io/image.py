@@ -56,6 +56,8 @@ def imencode(arr: np.ndarray, img_fmt: str = ".jpg",
     mx.recordio.pack_img's cv2.imencode step)."""
     Image = _pil()
     arr = np.ascontiguousarray(arr, np.uint8)
+    if arr.ndim == 3 and arr.shape[-1] == 1:
+        arr = arr[..., 0]   # PIL has no (H, W, 1) mode — grayscale is 2-D
     img = Image.fromarray(arr)
     buf = _io.BytesIO()
     fmt = img_fmt.lstrip(".").lower()
@@ -139,7 +141,10 @@ class ImageAugmenter:
     def __call__(self, arr: np.ndarray) -> np.ndarray:
         Image = _pil()
         rng = self._rng
-        img = Image.fromarray(np.ascontiguousarray(arr, np.uint8))
+        arr = np.ascontiguousarray(arr, np.uint8)
+        if arr.ndim == 3 and arr.shape[-1] == 1:
+            arr = arr[..., 0]   # PIL has no (H, W, 1) mode
+        img = Image.fromarray(arr)
         H, W, C = self.data_shape
         if C == 3 and img.mode != "RGB":
             img = img.convert("RGB")

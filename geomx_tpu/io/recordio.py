@@ -181,16 +181,25 @@ class ImageRecordIter:
         if self._encoded and imgs:
             raise ValueError(f"{path_imgrec} mixes encoded and raw "
                              "payloads")
+        # raw packs with an augmenter keep uint8 pixels so the augmenter
+        # runs per sample per epoch, exactly like the encoded path (aug
+        # silently skipped on raw data would diverge from the same
+        # pixels packed as PNG)
+        self._raw_u8 = (np.stack(imgs) if imgs and aug is not None
+                        else None)
         self.data = (np.stack(imgs).astype(np.float32) / 255.0
-                     if imgs else
+                     if imgs and aug is None else
                      np.zeros((0, *self.data_shape), np.float32))
         self.label = np.asarray(labels, np.float32)
 
     def _materialize(self, i: int) -> np.ndarray:
-        """Decode (+augment) one encoded sample -> float32 data_shape."""
-        from geomx_tpu.io.image import imdecode
+        """Decode (+augment) one sample -> float32 data_shape."""
+        if self._raw_u8 is not None:
+            arr = self._raw_u8[i]
+        else:
+            from geomx_tpu.io.image import imdecode
 
-        arr = imdecode(self._encoded[i])
+            arr = imdecode(self._encoded[i])
         if self.aug is not None:
             out = self.aug(arr)
         else:
@@ -206,14 +215,21 @@ class ImageRecordIter:
     def reset(self) -> None:
         pass
 
+    def _n_samples(self) -> int:
+        if self._encoded:
+            return len(self._encoded)
+        if self._raw_u8 is not None:
+            return len(self._raw_u8)
+        return len(self.data)
+
     def __len__(self) -> int:
-        n = len(self._encoded) or len(self.data)
-        return -(-n // self.batch_size)
+        return -(-self._n_samples() // self.batch_size)
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        n = len(self._encoded) or len(self.data)
+        n = self._n_samples()
         if n == 0:
             return
+        lazy = bool(self._encoded) or self._raw_u8 is not None
         idx = np.arange(n)
         if self.shuffle:
             self._rng.shuffle(idx)
@@ -222,7 +238,7 @@ class ImageRecordIter:
             sel = idx[i * bs:(i + 1) * bs]
             if len(sel) < bs:  # pad from head (round_batch)
                 sel = np.concatenate([sel, idx[:bs - len(sel)]])
-            if self._encoded:
+            if lazy:
                 yield (np.stack([self._materialize(j) for j in sel]),
                        self.label[sel])
             else:

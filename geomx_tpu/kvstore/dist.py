@@ -632,6 +632,17 @@ class KVStoreDist(KVStore):
             self._send_command(Command.SET_GRADIENT_COMPRESSION,
                                json.dumps(self._compression_params))
 
+    def set_multi_precision(self, multi_precision: bool = True) -> None:
+        """Keep fp32 master weights server-side for sub-fp32 models
+        (reference: kvstore.py sends kSetMultiPrecision when the
+        optimizer has multi_precision and weights are fp16; handled at
+        kvstore_dist_server.h:324). Send from the node that ships the
+        optimizer (master worker in HiPS, rank 0 single-tier)."""
+        if self.is_master_worker or (not self.cfg.has_global_tier
+                                     and self.rank == 0):
+            self._send_command(Command.SET_MULTI_PRECISION,
+                               "1" if multi_precision else "0")
+
     # -- optimizer state persistence (reference: kvstore.py:566/582) -----
     # In HiPS the LIVE optimizer states live on the server that applies
     # updates (its unpickled updater copy), not on this worker — so dump/

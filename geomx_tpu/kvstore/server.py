@@ -106,7 +106,7 @@ class _KeyState:
         "deferred_acks", "pending_pulls", "initialized", "staging", "rounds",
         "offset", "length", "total", "dtype", "elems_received", "init_elems",
         "fwd_parts", "fwd_expected", "fwd_acks_left", "version", "cycle",
-        "fwd_wire", "pre_init_pushes", "central_pushes",
+        "fwd_wire", "pre_init_pushes", "central_pushes", "master",
     )
 
     def __init__(self, offset: int):
@@ -135,6 +135,11 @@ class _KeyState:
         self.length = 0
         self.total = 0
         self.dtype = np.dtype(np.float32)
+        # fp32 master weights for multi-precision training (reference:
+        # kSetMultiPrecision + CreateMultiPrecisionCopies,
+        # kvstore_dist_server.h:50,324): created lazily at the first
+        # update after the flag lands on a non-fp32 key
+        self.master: Optional[np.ndarray] = None
         self.elems_received = 0
         self.init_elems = 0
         self.fwd_parts: Dict[int, np.ndarray] = {}
@@ -203,6 +208,9 @@ class KVStoreDistServer:
         self._stops_received = 0
         self.updater = None            # optimizer; applied on the global store
         self.gc = make_compressor(None)
+        # fp32 master-weight updates for fp16-stored keys (reference:
+        # kSetMultiPrecision, kvstore_dist_server.h:324)
+        self.multi_precision = False
         self.use_hfa = c.use_hfa
         self.period_k2 = max(c.hfa_k2, 1)
         self._stop = threading.Event()
@@ -430,9 +438,9 @@ class KVStoreDistServer:
 
         if not self.has_global_tier:
             # single-tier PS: apply the update here
-            new_w = (self.updater((key, off), st.merged, st.stored)
-                     if self.updater else st.merged)
-            st.stored = np.asarray(new_w, dtype=st.dtype).ravel()
+            st.stored = (self._run_updater(st, (key, off), st.merged)
+                         if self.updater else
+                         np.asarray(st.merged, dtype=st.dtype).ravel())
             st.initialized = True
             st.version += 1
             return ([lambda r=r, s=s: s.response(r)
@@ -549,9 +557,8 @@ class KVStoreDistServer:
             # DataHandleAsyncDefault :1532)
             grad = np.zeros(st.length, dtype=np.float32)
             grad[lo - rng.offset:lo - rng.offset + sub.size] = sub
-            new_w = (self.updater((key, rng.offset), grad, st.stored)
-                     if self.updater else st.stored)
-            st.stored = np.asarray(new_w, dtype=st.dtype).ravel()
+            st.stored = (self._run_updater(st, (key, rng.offset), grad)
+                         if self.updater else st.stored)
             st.version += 1
             acts = [lambda: srv.response(req)]
             if self.ts_local is not None:
@@ -621,9 +628,9 @@ class KVStoreDistServer:
 
         # global round complete: run the optimizer (reference: :1305-1319)
         st.rounds += 1
-        new_w = (self.updater((key, rng.offset), st.merged, st.stored)
-                 if self.updater else st.merged)
-        st.stored = np.asarray(new_w, dtype=st.dtype).ravel()
+        st.stored = (self._run_updater(st, (key, rng.offset), st.merged)
+                     if self.updater else
+                     np.asarray(st.merged, dtype=st.dtype).ravel())
         st.merged = None
         st.elems_received = 0
         st.version += 1
@@ -762,6 +769,30 @@ class KVStoreDistServer:
             out = KVPairs(keys=[key], vals=[data.copy()], offsets=[lo],
                           totals=[st.total], lens=[hi - lo])
         return lambda: srv.response(req, out)
+
+    def _run_updater(self, st: _KeyState, key_off, grad) -> np.ndarray:
+        """Apply the optimizer to this key's weights, returning the new
+        stored value in the key's wire dtype.
+
+        Multi-precision (reference: kSetMultiPrecision +
+        CreateMultiPrecisionCopies, kvstore_dist_server.h:50,324): when
+        the flag is on and the key is stored below fp32 (fp16 models,
+        examples/cnn_fp16.py), the optimizer runs against a PERSISTENT
+        fp32 master copy — repeated fp16 round-trips would otherwise
+        swallow small updates (lr * g below the fp16 ulp of the weight).
+        """
+        assert self.updater is not None, \
+            "_run_updater requires an optimizer; aggregator-mode " \
+            "fallbacks are per-site (merged aggregate vs kept weights)"
+        if self.multi_precision and st.dtype != np.float32:
+            if st.master is None or st.master.size != st.length:
+                st.master = st.stored.astype(np.float32).ravel()
+            st.master = np.asarray(
+                self.updater(key_off, grad, st.master),
+                dtype=np.float32).ravel()
+            return st.master.astype(st.dtype)
+        return np.asarray(self.updater(key_off, grad, st.stored),
+                          dtype=st.dtype).ravel()
 
     def _pull_compress_factor(self) -> int:
         return max(self.po_global.num_workers if self.po_global else 1, 1)
@@ -1137,6 +1168,10 @@ class KVStoreDistServer:
             self.updater = _safe_unpickle(bytes.fromhex(body))
         elif head == Command.SET_GRADIENT_COMPRESSION:
             self.gc = make_compressor(json.loads(body))
+        elif head == Command.SET_MULTI_PRECISION:
+            # idempotent enable (reference only ever turns it on,
+            # kvstore_dist_server.h:324-329)
+            self.multi_precision = body != "0"
         elif head == Command.SET_PROFILER_PARAMS:
             # workers remotely drive this server's profiler (reference:
             # ProcessServerProfilerCommands, kvstore_dist_server.h:383-430).

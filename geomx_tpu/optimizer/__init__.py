@@ -12,9 +12,10 @@ DCASGD:872, NAG:928, SGLD:981, Adam:1017, AdaGrad:1099, RMSProp:1158,
 AdaDelta:1236, Ftrl:1294, Adamax:1370, Nadam:1426), with the same
 update rules and hyperparameter names, plus the reference's
 ``lr_scheduler`` contract (optimizer.py:41 `_get_lr` + per-index update
-counts; schedulers in ``geomx_tpu.lr_scheduler``). Omitted: LBSGD (a
-large-batch warmup heuristic entangled with MXNet's multi-GPU batch
-accounting) and the ``ccSGD``/``Test`` aliases.
+counts; schedulers in ``geomx_tpu.lr_scheduler``), and LBSGD:681
+(gradient cumulation + warmup/LARS lr scaling — see its class
+docstring for the multi-precision divergence). Omitted: the
+``ccSGD``/``Test`` aliases.
 
 These implementations are numpy-first (the global server is a host-side
 process; the arrays it updates are parameter-server shards, typically small
@@ -39,7 +40,7 @@ from geomx_tpu import kernels_native
 __all__ = [
     "Optimizer", "SGD", "NAG", "Signum", "SGLD", "Adam", "Adamax",
     "Nadam", "FTML", "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "DCASGD",
-    "create",
+    "LBSGD", "create",
 ]
 
 
@@ -513,11 +514,97 @@ class DCASGD(Optimizer):
         return new_w
 
 
+class LBSGD(Optimizer):
+    """Large-Batch SGD: gradient cumulation to an effective macro-batch
+    plus a warmup-scheduled (or LARS layer-adaptive) lr multiplier
+    (reference: optimizer.py:681-860).
+
+    Per key: micro-batch gradients accumulate until ``batch_scale`` of
+    them arrived; the macro update then runs heavy-ball SGD on the mean
+    with lr scaled by the warmup schedule ('linear' | 'power2' | 'sqrt'
+    over ``warmup_epochs * updates_per_epoch`` macro-steps, ramping
+    1 -> batch_scale) or by the LARS trust ratio ('lars':
+    sqrt(||w||^2 / (||g||^2 + wd*||w||^2)), clipped to [0.01, 100]).
+    Off-boundary micro-steps leave the weight unchanged.
+
+    Divergence from the reference (documented): its per-optimizer fp16
+    master-copy machinery (multi_precision state tuples) is subsumed by
+    the server's fp32 master path (kvstore.server._run_updater), so the
+    optimizer itself is precision-agnostic.
+    """
+
+    def __init__(self, learning_rate: float = 0.01, momentum: float = 0.0,
+                 warmup_strategy: str = "linear", warmup_epochs: int = 5,
+                 batch_scale: int = 1, updates_per_epoch: int = 32,
+                 begin_epoch: int = 0, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        if warmup_strategy not in ("linear", "power2", "sqrt", "lars"):
+            raise ValueError(f"bad warmup_strategy {warmup_strategy!r}")
+        self.momentum = momentum
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = max(int(batch_scale), 1)
+        self.updates_per_epoch = updates_per_epoch
+        self.init_updates = begin_epoch * updates_per_epoch
+
+    def create_state(self, key, weight):
+        # "micro" counts gradients toward the NEXT macro boundary;
+        # "macro" counts completed macro updates (seeded by begin_epoch)
+        # — one counter for both (the reference's num_cums) misaligns
+        # the boundary whenever init_updates % batch_scale != 0
+        return {"mom": (np.zeros_like(weight, np.float32)
+                        if self.momentum else None),
+                "cum": None, "micro": 0, "macro": self.init_updates}
+
+    def _lbmult(self, nup: int) -> float:
+        """Warmup multiplier ramping 1 -> batch_scale (reference
+        :758-776)."""
+        nwup = self.warmup_epochs * self.updates_per_epoch
+        maxmult = float(self.batch_scale)
+        if nup >= nwup:
+            return maxmult
+        if nwup <= 1:
+            return 1.0
+        if self.warmup_strategy == "linear":
+            return 1.0 + (maxmult - 1) * nup / nwup
+        if self.warmup_strategy == "power2":
+            return 1.0 + (maxmult - 1) * (nup * nup) / (nwup * nwup)
+        if self.warmup_strategy == "sqrt":
+            return 1.0 + (maxmult - 1) * float(np.sqrt(nup / nwup))
+        return 1.0
+
+    def _lars(self, weight, g) -> float:
+        """LARS trust ratio, clipped (reference :778-789)."""
+        w2 = float(np.sum(weight * weight))
+        g2 = float(np.sum(g * g))
+        lars = float(np.sqrt(w2 / (g2 + self.wd * w2 + 1e-18)))
+        return float(np.clip(lars, 0.01, 100.0))
+
+    def step(self, key, weight, grad, state, lr):
+        state["cum"] = (grad.copy() if state["cum"] is None
+                        else state["cum"] + grad)
+        state["micro"] += 1
+        if state["micro"] % self.batch_scale != 0:
+            return weight          # mid-macro-batch: accumulate only
+        g = state["cum"] / self.batch_scale
+        state["cum"] = None
+        state["macro"] += 1
+        mult = (self._lars(weight, g) if self.warmup_strategy == "lars"
+                else self._lbmult(state["macro"]))
+        eff_lr = lr * mult
+        comp = g + self.wd * weight
+        if state["mom"] is not None:
+            state["mom"] *= self.momentum
+            state["mom"] += eff_lr * comp
+            return weight - state["mom"]
+        return weight - eff_lr * comp
+
+
 _REGISTRY = {
     "sgd": SGD, "nag": NAG, "signum": Signum, "sgld": SGLD,
     "adam": Adam, "adamax": Adamax, "nadam": Nadam, "ftml": FTML,
     "adagrad": AdaGrad, "rmsprop": RMSProp, "adadelta": AdaDelta,
-    "ftrl": Ftrl, "dcasgd": DCASGD,
+    "ftrl": Ftrl, "dcasgd": DCASGD, "lbsgd": LBSGD,
 }
 
 

@@ -175,3 +175,31 @@ def test_cifar_records_train_zoo_model(tmp_path):
             lv = [w + u for w, u in zip(lv, updates)]
             losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_raw_pack_applies_augmenter(tmp_path):
+    """aug= must run on raw-array packs too (review finding: silently
+    un-normalized raw batches would diverge from the same pixels as
+    PNG)."""
+    imgs = _imgs(4)
+    p = str(tmp_path / "rawaug.rec")
+    with MXRecordIO(p, "w") as w:
+        for i, img in enumerate(imgs):
+            w.write(pack_array(IRHeader(0, float(i), i, 0), img))
+    aug = ImageAugmenter((32, 32, 3), mean_rgb=[0.5] * 3,
+                         std_rgb=[0.25] * 3)
+    X, _ = next(iter(ImageRecordIter(p, (32, 32, 3), batch_size=4,
+                                     aug=aug)))
+    expect = (imgs[0].astype(np.float32) / 255.0 - 0.5) / 0.25
+    np.testing.assert_allclose(X[0], expect, atol=1e-5)
+
+
+def test_grayscale_hw1_arrays(tmp_path):
+    """(H, W, 1) arrays encode, augment, and iterate (PIL needs the
+    singleton axis squeezed internally)."""
+    rng = np.random.RandomState(3)
+    img = rng.randint(0, 256, (28, 28, 1), np.uint8)
+    back = imdecode(imencode(img, ".png"))
+    np.testing.assert_array_equal(back, img[..., 0])
+    out = ImageAugmenter((28, 28, 1))(img)
+    np.testing.assert_allclose(out, img.astype(np.float32) / 255.0)

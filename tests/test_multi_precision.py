@@ -1,0 +1,59 @@
+"""Server-side multi-precision (round-3 verdict item 8 / missing #2).
+
+Reference: kSetMultiPrecision (kvstore_dist_server.h:50, handled
+:324) — fp16-stored keys keep an fp32 master copy server-side so
+updates below the fp16 ulp of the weight still accumulate.
+"""
+
+import numpy as np
+
+from geomx_tpu.optimizer import SGD
+from geomx_tpu.simulate import InProcessHiPS
+
+# at weight 1.0 the fp16 ulp is ~9.8e-4: each lr*g = 1e-4 update is
+# swallowed by the fp16 round-trip unless a fp32 master accumulates
+LR = 1e-3
+GRAD = 0.1
+ROUNDS = 8
+
+
+def _train_fp16(multi_precision: bool) -> np.ndarray:
+    topo = InProcessHiPS(num_parties=2, workers_per_party=1).start()
+    out = {}
+    try:
+        def master_init(kv):
+            kv.set_optimizer(SGD(learning_rate=LR))
+            if multi_precision:
+                kv.set_multi_precision()
+            kv.init(0, np.ones(4, np.float16))
+            kv.wait()
+
+        def worker(kv):
+            w = np.ones(4, np.float16)
+            kv.init(0, w)
+            kv.pull(0, out=w)
+            kv.wait()
+            for _ in range(ROUNDS):
+                kv.push(0, np.full(4, GRAD / 2, np.float16))  # 2 workers
+                kv.pull(0, out=w)
+                kv.wait()
+            out[id(kv)] = w.copy()
+
+        topo.run_workers(worker, include_master=master_init, timeout=300)
+    finally:
+        topo.stop()
+    return next(iter(out.values()))
+
+
+def test_fp32_master_accumulates_sub_ulp_updates():
+    w = _train_fp16(multi_precision=True)
+    # master: 1.0 - 8 * 1e-3 * 0.1 = 0.9992 -> fp16 ~0.999
+    expect = 1.0 - ROUNDS * LR * GRAD
+    np.testing.assert_allclose(w.astype(np.float32), expect, atol=3e-4)
+
+
+def test_without_flag_fp16_swallows_updates():
+    """The failure mode multi-precision exists for: each sub-ulp update
+    rounds back to 1.0 in fp16, pinning the weight forever."""
+    w = _train_fp16(multi_precision=False)
+    np.testing.assert_array_equal(w.astype(np.float32), 1.0)

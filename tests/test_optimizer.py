@@ -284,3 +284,65 @@ def test_dcasgd_prev_is_pre_update_weight():
     expected = w1 - 0.1 * comp
     w2 = opt.step(0, w1, g, state, 0.1)
     np.testing.assert_allclose(w2, expected, rtol=1e-6)
+
+
+def test_lbsgd_cumulates_to_macro_batches():
+    """batch_scale micro-grads accumulate; the macro step applies SGD
+    on their mean (reference: optimizer.py:826-839)."""
+    from geomx_tpu.optimizer import LBSGD
+
+    opt = LBSGD(learning_rate=0.1, batch_scale=3, warmup_epochs=0)
+    w = np.array([1.0, 2.0], np.float32)
+    st = opt.create_state(0, w)
+    g1 = np.array([0.3, 0.6], np.float32)
+    g2 = np.array([0.6, 0.9], np.float32)
+    g3 = np.array([0.0, 0.3], np.float32)
+    # mid-macro-batch: weight untouched
+    assert opt.step(0, w, g1, st, 0.1) is w
+    assert opt.step(0, w, g2, st, 0.1) is w
+    w2 = opt.step(0, w, g3, st, 0.1)
+    # warmup done (warmup_epochs=0) -> mult = batch_scale = 3
+    mean_g = (g1 + g2 + g3) / 3
+    np.testing.assert_allclose(w2, w - 0.1 * 3 * mean_g, rtol=1e-6)
+    assert st["cum"] is None  # reset for the next macro batch
+
+
+def test_lbsgd_warmup_ramps_linearly():
+    from geomx_tpu.optimizer import LBSGD
+
+    opt = LBSGD(learning_rate=1.0, batch_scale=8, warmup_epochs=1,
+                updates_per_epoch=16)
+    # nup halfway through warmup: mult = 1 + 7 * 8/16
+    assert opt._lbmult(8) == 1.0 + 7 * 0.5
+    assert opt._lbmult(16) == 8.0   # warmup done
+    assert opt._lbmult(999) == 8.0
+
+
+def test_lbsgd_lars_trust_ratio():
+    from geomx_tpu.optimizer import LBSGD
+
+    opt = LBSGD(learning_rate=0.1, warmup_strategy="lars", wd=0.0)
+    w = np.array([3.0, 4.0], np.float32)       # ||w|| = 5
+    g = np.array([0.6, 0.8], np.float32)       # ||g|| = 1
+    assert abs(opt._lars(w, g) - 5.0) < 1e-5
+    # clipping
+    assert opt._lars(w, np.zeros(2, np.float32) + 1e-12) == 100.0
+    assert opt._lars(np.zeros(2, np.float32) + 1e-12, g) == 0.01
+
+
+def test_lbsgd_begin_epoch_keeps_macro_alignment():
+    """Review finding: seeding the cumulation counter with
+    begin_epoch*updates_per_epoch fired the first macro update early on
+    an under-scaled mean; the boundary counter must start at zero."""
+    from geomx_tpu.optimizer import LBSGD
+
+    opt = LBSGD(learning_rate=0.1, batch_scale=3, updates_per_epoch=32,
+                begin_epoch=1, warmup_epochs=0)
+    w = np.array([1.0], np.float32)
+    st = opt.create_state(0, w)
+    g = np.array([0.3], np.float32)
+    # first two micro-grads must NOT update
+    assert opt.step(0, w, g, st, 0.1) is w
+    assert opt.step(0, w, g, st, 0.1) is w
+    w2 = opt.step(0, w, g, st, 0.1)
+    assert not np.array_equal(w2, w)
