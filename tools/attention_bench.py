@@ -41,6 +41,11 @@ def main():
     ap.add_argument("--head-dim", type=int, default=64)
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--force-cpu", action="store_true")
+    ap.add_argument("--sweep-blocks", action="store_true",
+                    help="sweep flash block_q x block_k per seq len and "
+                         "report the fastest fwd+bwd combo vs dense")
+    ap.add_argument("--blocks", type=str, default="128,256,512",
+                    help="candidate block sizes for --sweep-blocks")
     args = ap.parse_args()
 
     import jax
@@ -78,6 +83,39 @@ def main():
         row["fwdbwd_speedup"] = round(
             row["dense_fwdbwd_ms"] / row["flash_fwdbwd_ms"], 2)
         print(json.dumps(row), flush=True)
+
+        if not args.sweep_blocks:
+            continue
+        # block-size sweep: the fwd+bwd time is what a train step pays
+        cands = [int(b) for b in args.blocks.split(",")]
+        best = None
+        for bq in cands:
+            for bk in cands:
+                if bq > T or bk > T:
+                    continue
+                fg = jax.jit(jax.grad(
+                    lambda q, k, v, _bq=bq, _bk=bk: flash_attention(
+                        q, k, v, block_q=_bq, block_k=_bk).astype(
+                        jnp.float32).sum(), argnums=(0, 1, 2)))
+                try:
+                    ms = _time(fg, q, k, v, iters=10)
+                except Exception as e:  # noqa: BLE001 — report and move on
+                    print(json.dumps({"T": T, "block_q": bq,
+                                      "block_k": bk,
+                                      "error": str(e)[:200]}), flush=True)
+                    continue
+                print(json.dumps({"T": T, "block_q": bq, "block_k": bk,
+                                  "flash_fwdbwd_ms": round(ms, 3)}),
+                      flush=True)
+                if best is None or ms < best[0]:
+                    best = (ms, bq, bk)
+        if best:
+            print(json.dumps({
+                "T": T, "best_block_q": best[1], "best_block_k": best[2],
+                "best_flash_fwdbwd_ms": round(best[0], 3),
+                "dense_fwdbwd_ms": row["dense_fwdbwd_ms"],
+                "best_speedup": round(
+                    row["dense_fwdbwd_ms"] / best[0], 2)}), flush=True)
 
 
 if __name__ == "__main__":
