@@ -207,6 +207,21 @@ class KVStoreDist(KVStore):
         keys = self._as_key_list(key)
         values = value if isinstance(value, (list, tuple)) and len(keys) > 1 \
             else [value]
+        if len(keys) > 1:
+            # a key twice in one round would double-count this worker's
+            # FSA contribution and wedge the round barrier — reject it
+            # loudly here rather than hanging in wait()
+            if len(set(keys)) != len(keys):
+                raise ValueError("push: duplicate keys in one round")
+            if self._ts is None:
+                # list form = batched wire: ONE message per server
+                # carrying every (key, shard) entry for it, acked once
+                # (the server merges per-key acks —
+                # kvstore.server._BatchResponder). Cuts the per-round
+                # message count from 2*n_keys to 2*n_servers; per-key
+                # pushes remain for priority interleaving (P3).
+                self._push_batch(keys, values, priority)
+                return
         for k, v in zip(keys, values):
             merged = _sum_values(v)
             info = self._info(k, merged)
@@ -229,6 +244,52 @@ class KVStoreDist(KVStore):
                               lens=[sh.length])
                 self.kvw.push(kvs, sh.server_rank, priority=priority,
                               cb=lambda ts, kk=k: self._on_push_ack(kk, ts))
+
+    def _push_batch(self, keys: List[int], values, priority: int) -> None:
+        per_server: Dict[int, KVPairs] = {}
+        server_keys: Dict[int, List[int]] = {}
+        for k, v in zip(keys, values):
+            merged = _sum_values(v)
+            info = self._info(k, merged)
+            flat = np.ascontiguousarray(merged).ravel()
+            for sh in info.shards:
+                kvs = per_server.setdefault(sh.server_rank, KVPairs())
+                kvs.keys.append(k)
+                kvs.vals.append(flat[sh.offset:sh.offset + sh.length])
+                kvs.offsets.append(sh.offset)
+                kvs.totals.append(sh.total)
+                kvs.lens.append(sh.length)
+                server_keys.setdefault(sh.server_rank, []).append(k)
+        with self._lock:
+            for ks in server_keys.values():
+                for k in ks:
+                    self._push_acks_left[k] = (
+                        self._push_acks_left.get(k, 0) + 1)
+        for ks in server_keys.values():
+            for k in ks:
+                self._track(1, k)
+        for srank, kvs in per_server.items():
+            ks = tuple(server_keys[srank])
+            self.kvw.push(kvs, srank, priority=priority,
+                          cb=lambda ts, kk=ks:
+                          self._on_batch_push_ack(kk, ts))
+
+    def _on_batch_push_ack(self, keys, ts: int) -> None:
+        fail = self.kvw.take_failure(ts)
+        if fail is not None:
+            with self._lock:
+                self._transport_errors.append(
+                    f"push keys {list(keys)}: {fail}")
+        ready = []
+        with self._lock:
+            for k in keys:
+                self._push_acks_left[k] -= 1
+                if self._push_acks_left[k] == 0 and k in self._deferred:
+                    ready.extend(self._deferred.pop(k))
+        for k in keys:
+            self._untrack(k)
+        for fn in ready:
+            fn()
 
     def _ts_final_push(self, key: int, off: int, total: int,
                        arr: np.ndarray, num_merge: int, ver: int) -> None:
@@ -273,16 +334,115 @@ class KVStoreDist(KVStore):
 
     def pull(self, key, out=None, priority: int = 0):
         """Async pull into ``out`` (ordered after this key's push acks);
-        blocking when ``out`` is None. Use wait()/waitall to join."""
+        blocking when ``out`` is None. Use wait()/waitall to join.
+
+        The list form with ``out`` batches the wire like list pushes:
+        one request per server covering every (key, shard) entry, one
+        merged response back."""
         keys = self._as_key_list(key)
         outs = out if isinstance(out, (list, tuple)) and len(keys) > 1 \
             else [out] * len(keys)
+        if len(keys) > 1 and len(set(keys)) != len(keys):
+            raise ValueError("pull: duplicate keys in one call")
+        if (len(keys) > 1 and out is not None
+                and not (self._ts is not None
+                         and any(self._ts_ver.get(k, 0) for k in keys))):
+            self._pull_batch(keys, list(outs), priority)
+            return None
         results = []
         for k, o in zip(keys, outs):
             results.append(self._pull_one(k, o, priority))
         if out is None:
             return results[0] if len(results) == 1 else results
         return None
+
+    def _pull_batch(self, keys: List[int], outs: List, priority: int
+                    ) -> None:
+        for k, o in zip(keys, outs):
+            assert self._key_info.get(k) is not None, \
+                f"pull of key {k} before init"
+            if not (isinstance(o, np.ndarray) and o.flags.writeable):
+                raise TypeError(
+                    "batched pull requires writable numpy ndarrays")
+        bufs = {k: np.zeros(self._key_info[k].total, np.float32)
+                for k in keys}
+        out_of = dict(zip(keys, outs))
+        # per-server request covering every (key, shard) entry on it
+        per_server: Dict[int, KVPairs] = {}
+        server_keys: Dict[int, List[int]] = {}
+        msgs_left: Dict[int, int] = {}   # key -> responses outstanding
+        for k in keys:
+            info = self._key_info[k]
+            for sh in info.shards:
+                kvs = per_server.setdefault(sh.server_rank, KVPairs())
+                kvs.keys.append(k)
+                kvs.vals.append(np.zeros(0, np.float32))
+                kvs.offsets.append(sh.offset)
+                kvs.totals.append(sh.total)
+                kvs.lens.append(sh.length)
+                server_keys.setdefault(sh.server_rank, []).append(k)
+        # one response per server message; a key completes when every
+        # server holding one of its shards has responded
+        with self._lock:
+            for srank, ks in server_keys.items():
+                for k in set(ks):
+                    msgs_left[k] = msgs_left.get(k, 0) + 1
+        for k in keys:
+            self._track(1, k)
+
+        def on_data(ts: int, srank: int):
+            fail = self.kvw.take_failure(ts)
+            if fail is not None:
+                with self._lock:
+                    self._transport_errors.append(
+                        f"pull keys {sorted(set(server_keys[srank]))}: "
+                        f"{fail}")
+            finished = []
+            for kvs in self.kvw.take_response(ts):
+                for i, k in enumerate(kvs.keys):
+                    data = np.asarray(kvs.vals[i]).ravel().astype(
+                        np.float32)
+                    r_off = kvs.offset_of(i)
+                    buf = bufs[k]
+                    n = min(data.size, buf.size - r_off)
+                    buf[r_off:r_off + n] = data[:n]
+            with self._lock:
+                for k in set(server_keys[srank]):
+                    msgs_left[k] -= 1
+                    if msgs_left[k] == 0:
+                        finished.append(k)
+            for k in finished:
+                info = self._key_info[k]
+                np.copyto(out_of[k], bufs[k].reshape(info.shape)
+                          .astype(info.dtype, copy=False))
+                self._untrack(k)
+
+        for srank, kvs in per_server.items():
+            def issue(sr=srank, kv=kvs):
+                self.kvw.pull(kv.keys, sr, offsets=kv.offsets,
+                              totals=kv.totals, lens=kv.lens,
+                              priority=priority,
+                              cb=lambda ts, s=sr: on_data(ts, s))
+
+            # the message must not go out until EVERY key in it has its
+            # push round acked (the per-key freshness ordering, batched)
+            with self._lock:
+                waiting = [k for k in set(server_keys[srank])
+                           if self._push_acks_left.get(k, 0) > 0]
+                if waiting:
+                    pending = [len(waiting)]
+
+                    def arm(fn=issue, box=pending):
+                        with self._lock:
+                            box[0] -= 1
+                            ready = box[0] == 0
+                        if ready:
+                            fn()
+
+                    for k in waiting:
+                        self._deferred.setdefault(k, []).append(arm)
+                    continue
+            issue()
 
     def _pull_one(self, key: int, out, priority: int):
         info = self._key_info.get(key)

@@ -97,6 +97,52 @@ def _safe_unpickle(data: bytes):
     return _SysModulesUnpickler(io.BytesIO(data)).load()
 
 
+class _BatchResponder:
+    """One response per multi-key request message.
+
+    A request carrying N (key, offset) entries is handled by N
+    independent per-key state machines, each of which acks exactly once
+    (possibly deferred across a round). The transport allows ONE
+    response per request (the worker tracker fires on the first, and
+    the resender dedups by timestamp), so this proxy counts the per-key
+    acks and emits a single merged response when the last one lands.
+    Pull responses merge their per-key KVPairs entry lists; push acks
+    merge to an empty ack.
+    """
+
+    __slots__ = ("_srv", "_left", "_parts", "_lock")
+
+    def __init__(self, srv, n: int):
+        self._srv = srv
+        self._left = n
+        self._parts: List[KVPairs] = []
+        self._lock = threading.Lock()
+
+    def response(self, req, kvs: Optional[KVPairs] = None,
+                 body: str = "") -> None:
+        with self._lock:
+            if kvs is not None:
+                self._parts.append(kvs)
+            self._left -= 1
+            if self._left > 0:
+                return
+            parts, self._parts = self._parts, []
+        if not parts:
+            self._srv.response(req)
+            return
+        merged = KVPairs(compr=next((p.compr for p in parts if p.compr),
+                                    ""))
+        for p in parts:
+            for i in range(len(p.keys)):
+                merged.keys.append(p.keys[i])
+                merged.vals.append(p.vals[i])
+                merged.aux.append(p.aux[i] if i < len(p.aux) else None)
+                merged.offsets.append(p.offset_of(i))
+                merged.totals.append(p.total_of(i))
+                merged.lens.append(p.len_of(i))
+        self._srv.response(req, merged)
+
+
 class _KeyState:
     """Per-(key, shard-offset) protocol state (UpdateBuf + store_ entry)."""
 
@@ -349,6 +395,11 @@ class KVStoreDistServer:
     def _handle_data(self, req: ReqMeta, kvs: KVPairs, srv: KVServer,
                      global_store: bool, global_tier: bool) -> None:
         acts: List[Action] = []
+        if len(kvs.keys) > 1:
+            # multi-key request: N independent per-key machines each ack
+            # once; the transport allows one response per message, so a
+            # countdown proxy merges them (see _BatchResponder)
+            srv = _BatchResponder(srv, len(kvs.keys))
         for i, key in enumerate(kvs.keys):
             off = kvs.offset_of(i)
             total = kvs.total_of(i)

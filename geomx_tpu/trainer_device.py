@@ -54,7 +54,17 @@ class DeviceResidentTrainer:
         """``params``: list of array leaves (key of leaf i =
         ``begin_key + i``); ``grad_fn(leaf_list, X, y) -> (loss,
         grad_leaves)`` must be jit-compatible (it is traced into the
-        fused device step)."""
+        fused device step).
+
+        The local optimizer is deliberately SGD on the aggregated
+        selection: BSC's residual feedback DELIVERS accumulated
+        gradients (the v-buffer sums until a coordinate is selected),
+        so plain SGD applies each coordinate's full accumulated mass;
+        heavy-ball momentum compounds with the u-buffer's own 0.9
+        momentum correction and diverges, and per-coordinate adaptive
+        optimizers (Adam) see each coordinate only ~threshold*rounds
+        times so their moment estimates starve (both measured —
+        bench.py bench_hips_bsc docstring)."""
         import jax
         import jax.numpy as jnp
 

@@ -39,3 +39,17 @@ def test_fsa_breakage_named():
     bench = _load_bench()
     fails = bench.parity_violations(1.0, 0.5, 1.0)
     assert [f["config"] for f in fails] == ["hips_cnn"]
+
+
+def test_bsc_compares_iteration_matched_baseline():
+    """The BSC probe runs longer than the dense probes; its baseline
+    must be the nokv accuracy at the SAME iteration count."""
+    bench = _load_bench()
+    # nokv@100 = 0.95, nokv@200 = 1.0: bsc 0.975 fails vs the
+    # 200-iter baseline even though it beats the 100-iter one
+    fails = bench.parity_violations(0.95, 0.95, 0.975, nokv_acc_long=1.0)
+    assert [f["config"] for f in fails] == ["hips_bsc_cnn"]
+    assert fails[0]["baseline"] == 1.0
+    # and passes when within tolerance of the matched baseline
+    assert bench.parity_violations(0.95, 0.95, 0.985,
+                                   nokv_acc_long=1.0) == []
