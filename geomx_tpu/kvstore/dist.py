@@ -260,6 +260,13 @@ class KVStoreDist(KVStore):
                 kvs.totals.append(sh.total)
                 kvs.lens.append(sh.length)
                 server_keys.setdefault(sh.server_rank, []).append(k)
+        self._send_batch_pushes(per_server, server_keys, priority)
+
+    def _send_batch_pushes(self, per_server: Dict[int, KVPairs],
+                           server_keys: Dict[int, List[int]],
+                           priority: int) -> None:
+        """Shared tail of the batched push paths: register per-(server,
+        shard) ack bookkeeping and send one message per server."""
         with self._lock:
             for ks in server_keys.values():
                 for k in ks:
@@ -426,23 +433,7 @@ class KVStoreDist(KVStore):
 
             # the message must not go out until EVERY key in it has its
             # push round acked (the per-key freshness ordering, batched)
-            with self._lock:
-                waiting = [k for k in set(server_keys[srank])
-                           if self._push_acks_left.get(k, 0) > 0]
-                if waiting:
-                    pending = [len(waiting)]
-
-                    def arm(fn=issue, box=pending):
-                        with self._lock:
-                            box[0] -= 1
-                            ready = box[0] == 0
-                        if ready:
-                            fn()
-
-                    for k in waiting:
-                        self._deferred.setdefault(k, []).append(arm)
-                    continue
-            issue()
+            self._issue_after_push_acks(set(server_keys[srank]), issue)
 
     def _pull_one(self, key: int, out, priority: int):
         info = self._key_info.get(key)
@@ -510,13 +501,28 @@ class KVStoreDist(KVStore):
             return buf.reshape(info.shape).astype(info.dtype, copy=False)
         return None
 
-    def _issue_after_push_acks(self, key: int, issue: Callable) -> None:
-        """Run ``issue`` now, or defer it until this key's in-flight push
-        round is fully acked (the push-ack -> pull ordering that
-        guarantees a pull observes fresh parameters)."""
+    def _issue_after_push_acks(self, key, issue: Callable) -> None:
+        """Run ``issue`` now, or defer it until the in-flight push round
+        of ``key`` (an int, or an iterable of keys for batched
+        requests — then ALL of them) is fully acked: the push-ack ->
+        pull ordering that guarantees a pull observes fresh
+        parameters."""
+        keys = [key] if isinstance(key, int) else list(key)
         with self._lock:
-            if self._push_acks_left.get(key, 0) > 0:
-                self._deferred.setdefault(key, []).append(issue)
+            waiting = [k for k in keys
+                       if self._push_acks_left.get(k, 0) > 0]
+            if waiting:
+                pending = [len(waiting)]
+
+                def arm():
+                    with self._lock:
+                        pending[0] -= 1
+                        ready = pending[0] == 0
+                    if ready:
+                        issue()
+
+                for k in waiting:
+                    self._deferred.setdefault(k, []).append(arm)
                 return
         issue()
 
@@ -739,6 +745,137 @@ class KVStoreDist(KVStore):
                 return (np.zeros(0, np.float32), np.zeros(0, np.int64))
             return (np.concatenate([p[0] for p in got]),
                     np.concatenate([p[1] for p in got]))
+
+        return join
+
+    def push_bsc_batch(self, keys, values_list, indices_list,
+                       priority: int = 0) -> None:
+        """Batched ``push_bsc``: one message per server carrying every
+        key's sparse selection (same countdown-merged ack as the dense
+        batched wire)."""
+        assert len(set(keys)) == len(keys), "duplicate keys in one round"
+        per_server: Dict[int, KVPairs] = {}
+        server_keys: Dict[int, List[int]] = {}
+        prepared = []
+        for k, values, indices in zip(keys, values_list, indices_list):
+            vals = np.ascontiguousarray(values, dtype=np.float32).ravel()
+            idx = np.asarray(indices, dtype=np.int64).ravel()
+            assert vals.size == idx.size, "values/indices mismatch"
+            info = self._key_info.get(k)
+            assert info is not None, f"push_bsc of key {k} before init"
+            if idx.size and (idx.min() < 0 or idx.max() >= info.total):
+                raise IndexError(
+                    f"push_bsc: indices out of range for key {k}")
+            prepared.append((k, vals, idx, info))
+        for k, vals, idx, info in prepared:
+            for sh in info.shards:
+                sel = (idx >= sh.offset) & (idx < sh.offset + sh.length)
+                kvs = per_server.setdefault(sh.server_rank,
+                                            KVPairs(compr="bsc"))
+                kvs.keys.append(k)
+                kvs.vals.append(vals[sel])
+                kvs.aux.append((idx[sel] - sh.offset).astype(np.int32))
+                kvs.offsets.append(sh.offset)
+                kvs.totals.append(sh.total)
+                kvs.lens.append(sh.length)
+                server_keys.setdefault(sh.server_rank, []).append(k)
+        self._send_batch_pushes(per_server, server_keys, priority)
+
+    def pull_bsc_batch(self, keys, priority: int = 0,
+                       timeout: float = 300.0):
+        """Batched ``pull_bsc``: one request per server; returns a
+        ``join() -> {key: (values, flat_indices)}`` callable."""
+        assert len(set(keys)) == len(keys), "duplicate keys in one call"
+        per_server: Dict[int, KVPairs] = {}
+        server_keys: Dict[int, List[int]] = {}
+        for k in keys:
+            info = self._key_info.get(k)
+            assert info is not None, f"pull_bsc of key {k} before init"
+            for sh in info.shards:
+                kvs = per_server.setdefault(sh.server_rank,
+                                            KVPairs(compr="bsc"))
+                kvs.keys.append(k)
+                kvs.vals.append(np.zeros(0, np.float32))
+                kvs.offsets.append(sh.offset)
+                kvs.totals.append(sh.total)
+                kvs.lens.append(sh.length)
+                server_keys.setdefault(sh.server_rank, []).append(k)
+        parts: Dict[int, List] = {k: [] for k in keys}
+        fails: List[str] = []
+        done = threading.Event()
+        remaining = [len(per_server)]
+        # tracked per (server, shard) entry, untracked the same way on
+        # that server's response — symmetric with _on_batch_push_ack
+        for ks in server_keys.values():
+            for k in ks:
+                self._track(1, k)
+
+        def on_data(ts: int, srank: int):
+            fail = self.kvw.take_failure(ts)
+            if fail is not None:
+                with self._lock:
+                    fails.append(
+                        f"pull_bsc keys {sorted(set(server_keys[srank]))}"
+                        f": {fail}")
+                    self._transport_errors.append(fails[-1])
+            for kvs in self.kvw.take_response(ts):
+                for i, k in enumerate(kvs.keys):
+                    # array work OUTSIDE the store lock (it serializes
+                    # every transport callback on this worker)
+                    data = np.asarray(kvs.vals[i],
+                                      dtype=np.float32).ravel()
+                    r_off = kvs.offset_of(i)
+                    aux = kvs.aux[i] if i < len(kvs.aux) else None
+                    if kvs.compr == "bsc" and aux is not None:
+                        entry = (data,
+                                 np.asarray(aux, np.int64).ravel()
+                                 + r_off)
+                    else:
+                        nz = np.nonzero(data)[0]
+                        entry = (data[nz].astype(np.float32), nz + r_off)
+                    with self._lock:
+                        parts[k].append(entry)
+            last = False
+            with self._lock:
+                remaining[0] -= 1
+                last = remaining[0] == 0
+            if last:
+                done.set()
+            for k in server_keys[srank]:
+                self._untrack(k)
+
+        for srank, kvs in per_server.items():
+            def issue(sr=srank, kv=kvs):
+                self.kvw.pull(kv.keys, sr, offsets=kv.offsets,
+                              totals=kv.totals, lens=kv.lens,
+                              priority=priority, compr="bsc",
+                              cb=lambda ts, s=sr: on_data(ts, s))
+
+            self._issue_after_push_acks(set(server_keys[srank]), issue)
+
+        def join():
+            if not done.wait(timeout):
+                raise TimeoutError("pull_bsc_batch timed out")
+            with self._lock:
+                errs = list(fails)
+                if errs:
+                    self._transport_errors = [
+                        e for e in self._transport_errors
+                        if e not in fails]
+            if errs:
+                raise RuntimeError("transport gave up on "
+                                   + "; ".join(errs))
+            out = {}
+            with self._lock:
+                got = {k: list(v) for k, v in parts.items()}
+            for k, ps in got.items():
+                if not ps:
+                    out[k] = (np.zeros(0, np.float32),
+                              np.zeros(0, np.int64))
+                else:
+                    out[k] = (np.concatenate([p[0] for p in ps]),
+                              np.concatenate([p[1] for p in ps]))
+            return out
 
         return join
 

@@ -214,17 +214,29 @@ class DeviceResidentTrainer:
 
     def _kv_round_sparse(self, vals: np.ndarray, idx: np.ndarray
                          ) -> Tuple[np.ndarray, np.ndarray]:
-        """Element-sparse LAN round: O(k_i) bytes and host work per key.
-        The fwd layout is per-key contiguous (segment i covers
-        kofs[i]:kofs[i+1]), so partitioning is slicing, not scanning."""
+        """Element-sparse LAN round: O(k_i) bytes and host work per key,
+        batched to one message per server per direction when the store
+        supports it. The fwd layout is per-key contiguous (segment i
+        covers kofs[i]:kofs[i+1]), so partitioning is slicing, not
+        scanning."""
+        n = len(self._sizes)
+        keys = [self.begin_key + i for i in range(n)]
+        segs = [(int(self._kofs[i]), int(self._kofs[i + 1]),
+                 int(self._offsets[i])) for i in range(n)]
+        if hasattr(self.kv, "push_bsc_batch"):
+            self.kv.push_bsc_batch(
+                keys, [vals[lo:hi] for lo, hi, _ in segs],
+                [idx[lo:hi] - off for lo, hi, off in segs])
+            agg = self.kv.pull_bsc_batch(keys)()
+            ups = [agg[k][0] for k in keys]
+            upi = [agg[k][1] + off
+                   for k, (_, _, off) in zip(keys, segs)]
+            return np.concatenate(ups), np.concatenate(upi)
         handles = []
-        for i in range(len(self._sizes)):
-            lo, hi = int(self._kofs[i]), int(self._kofs[i + 1])
-            key = self.begin_key + i
-            off = int(self._offsets[i])
-            self.kv.push_bsc(key, vals[lo:hi], idx[lo:hi] - off,
+        for i, (lo, hi, off) in enumerate(segs):
+            self.kv.push_bsc(keys[i], vals[lo:hi], idx[lo:hi] - off,
                              priority=-i)
-            handles.append((i, self.kv.pull_bsc(key, priority=-i)))
+            handles.append((i, self.kv.pull_bsc(keys[i], priority=-i)))
         ups, upi = [], []
         for i, join in handles:
             avals, aidx = join()
