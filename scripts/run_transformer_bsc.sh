@@ -1,0 +1,12 @@
+#!/bin/bash
+# Single-host HiPS demo: 12 processes, 3 parties — the 59M transformer
+# through the device-resident Bi-Sparse trainer (params never leave the
+# accelerator; element-sparse LAN wire). Beyond the reference's script
+# set: GeoMX's model layer predates transformers, so this config pairs
+# its HiPS+BSC recipe (scripts/cpu/run_bsc.sh) with the TPU-era model.
+# Small-model smoke on CPU:
+#   bash scripts/run_transformer_bsc.sh --cpu --dim 64 --depth 2 \
+#        --heads 4 --vocab 256 --seq-len 64 --max-iters 10
+cd "$(dirname "$0")"
+source ./hips_env.sh
+launch_hips "$REPO_DIR/examples/transformer_bsc_device.py" "$@"

@@ -29,7 +29,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run_launch(script: str, extra_args, n_iters: int, timeout: float,
-                expect_lines: int = 0, env_extra=None):
+                expect_lines: int = 0, env_extra=None,
+                pattern: str = r"Test Acc (\d+\.\d+)",
+                pass_max_iters: bool = True):
     env = dict(os.environ)
     if env_extra:
         env.update(env_extra)
@@ -42,9 +44,11 @@ def _run_launch(script: str, extra_args, n_iters: int, timeout: float,
         # separate processes
         "XLA_FLAGS": "",
     })
+    argv = ["bash", os.path.join(REPO, "scripts", script)]
+    if pass_max_iters:
+        argv += ["--max-iters", str(n_iters)]
     proc = subprocess.Popen(
-        ["bash", os.path.join(REPO, "scripts", script),
-         "--max-iters", str(n_iters), *extra_args],
+        [*argv, *extra_args],
         cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True, start_new_session=True,
     )
@@ -56,7 +60,7 @@ def _run_launch(script: str, extra_args, n_iters: int, timeout: float,
         pytest.fail(f"launch timed out; output:\n{out[-4000:]}")
 
     assert proc.returncode == 0, f"launch failed:\n{out[-4000:]}"
-    accs = [float(m) for m in re.findall(r"Test Acc (\d+\.\d+)", out)]
+    accs = [float(m) for m in re.findall(pattern, out)]
     expect = expect_lines or n_iters
     assert len(accs) == expect, \
         f"expected {expect} iteration lines, got:\n{out[-4000:]}"
@@ -212,6 +216,21 @@ def test_inter_ts_subprocess_topology():
     tier (ENABLE_INTER_TS=1)."""
     accs = _run_launch("run_inter_ts.sh", [], n_iters=15, timeout=300)
     assert max(accs[-5:]) > 0.3, f"inter-TS did not learn: {accs}"
+
+
+@pytest.mark.slow
+def test_transformer_bsc_subprocess_topology():
+    """The round-4 flagship: a transformer through the device-resident
+    BSC trainer (element-sparse wire) in the real 12-process topology.
+    Small dims keep the 12 jax compiles tractable; the loss lines are
+    the learning signal (transformer_bsc_device.py prints Loss, not
+    Test Acc)."""
+    losses = _run_launch(
+        "run_transformer_bsc.sh",
+        ["--cpu", "--dim", "64", "--depth", "2", "--heads", "4",
+         "--vocab", "256", "--seq-len", "64", "-bs", "4"],
+        n_iters=12, timeout=360, pattern=r"Loss (\d+\.\d+)")
+    assert min(losses[-6:]) < losses[0], f"no learning: {losses}"
 
 
 if __name__ == "__main__":
