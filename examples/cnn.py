@@ -111,9 +111,11 @@ def main():
         for X, y in train_iter:
             loss, grads = grad_step([jnp.asarray(l) for l in leaves],
                                     jnp.asarray(X), jnp.asarray(y))
-            for idx, g in enumerate(grads):
-                kv.push(idx, np.asarray(g), priority=-idx)
-                kv.pull(idx, out=leaves[idx], priority=-idx)
+            # list form: one batched message per server each way
+            # (per-key prioritized sends under ENABLE_P3)
+            keylist = list(range(len(grads)))
+            kv.push(keylist, [np.asarray(g) for g in grads])
+            kv.pull(keylist, out=leaves)
             kv.wait()
 
             test_acc = eval_acc(test_iter, leaves, eval_step)

@@ -80,10 +80,11 @@ def main():
         for X, y in train_iter:
             loss, grads = grad_step([jnp.asarray(l) for l in leaves],
                                     jnp.asarray(X), jnp.asarray(y))
-            for idx, g in enumerate(grads):
-                kv.push(idx, np.asarray(g), priority=-idx)
-                # pull the globally-aggregated (sparsified) gradient
-                kv.pull(idx, out=grad_bufs[idx], priority=-idx)
+            # one batched message per server each way; the pull-back
+            # is the globally-aggregated (sparsified) gradient
+            keylist = list(range(len(grads)))
+            kv.push(keylist, [np.asarray(g) for g in grads])
+            kv.pull(keylist, out=grad_bufs)
             kv.wait()
             for idx in range(len(leaves)):
                 leaves[idx] = np.asarray(

@@ -78,9 +78,10 @@ def main():
         for X, y in train_iter:
             loss, grads = grad_step([jnp.asarray(l) for l in leaves],
                                     jnp.asarray(X), jnp.asarray(y))
-            for idx, g in enumerate(grads):
-                kv.push(idx, np.asarray(g).astype(np.float16), priority=-idx)
-                kv.pull(idx, out=leaves16[idx], priority=-idx)
+            keylist = list(range(len(grads)))
+            kv.push(keylist, [np.asarray(g).astype(np.float16)
+                              for g in grads])
+            kv.pull(keylist, out=leaves16)
             kv.wait()
             leaves = [l.astype(np.float32) for l in leaves16]
 

@@ -80,9 +80,9 @@ def main():
         for X, y in train_iter:
             loss, grads = grad_step([jnp.asarray(l) for l in leaves],
                                     jnp.asarray(X), jnp.asarray(y))
-            for idx, g in enumerate(grads):
-                kv.push(idx, np.asarray(g), priority=-idx)
-                kv.pull(idx, out=grad_bufs[idx], priority=-idx)
+            keylist = list(range(len(grads)))
+            kv.push(keylist, [np.asarray(g) for g in grads])
+            kv.pull(keylist, out=grad_bufs)
             kv.wait()
             for idx in range(len(leaves)):
                 leaves[idx] = np.asarray(

@@ -213,14 +213,21 @@ class KVStoreDist(KVStore):
             # loudly here rather than hanging in wait()
             if len(set(keys)) != len(keys):
                 raise ValueError("push: duplicate keys in one round")
-            if self._ts is None:
+            if self._ts is None and not self.cfg.enable_p3:
                 # list form = batched wire: ONE message per server
                 # carrying every (key, shard) entry for it, acked once
                 # (the server merges per-key acks —
                 # kvstore.server._BatchResponder). Cuts the per-round
-                # message count from 2*n_keys to 2*n_servers; per-key
-                # pushes remain for priority interleaving (P3).
+                # message count from 2*n_keys to 2*n_servers.
                 self._push_batch(keys, values, priority)
+                return
+            if self.cfg.enable_p3:
+                # P3 wants per-key messages so the priority send thread
+                # can interleave layers: list order IS layer order, so
+                # later entries get lower priority (reference:
+                # kvstore_dist.h:768 slicing + van.cc:548 queues)
+                for i, (k, v) in enumerate(zip(keys, values)):
+                    self.push(k, v, priority=priority - i)
                 return
         for k, v in zip(keys, values):
             merged = _sum_values(v)
@@ -351,6 +358,11 @@ class KVStoreDist(KVStore):
             else [out] * len(keys)
         if len(keys) > 1 and len(set(keys)) != len(keys):
             raise ValueError("pull: duplicate keys in one call")
+        if len(keys) > 1 and self.cfg.enable_p3 and out is not None:
+            # per-key prioritized pulls (see the push list form)
+            for i, (k, o) in enumerate(zip(keys, outs)):
+                self._pull_one(k, o, priority - i)
+            return None
         if (len(keys) > 1 and out is not None
                 and not (self._ts is not None
                          and any(self._ts_ver.get(k, 0) for k in keys))):
@@ -752,8 +764,15 @@ class KVStoreDist(KVStore):
                        priority: int = 0) -> None:
         """Batched ``push_bsc``: one message per server carrying every
         key's sparse selection (same countdown-merged ack as the dense
-        batched wire)."""
+        batched wire). Under ENABLE_P3 it fans out per key with
+        descending priority, like the dense list form — one coalesced
+        message would defeat the priority send thread's interleaving."""
         assert len(set(keys)) == len(keys), "duplicate keys in one round"
+        if self.cfg.enable_p3:
+            for i, (k, v, ix) in enumerate(zip(keys, values_list,
+                                               indices_list)):
+                self.push_bsc(k, v, ix, priority=priority - i)
+            return
         per_server: Dict[int, KVPairs] = {}
         server_keys: Dict[int, List[int]] = {}
         prepared = []
@@ -784,8 +803,18 @@ class KVStoreDist(KVStore):
     def pull_bsc_batch(self, keys, priority: int = 0,
                        timeout: float = 300.0):
         """Batched ``pull_bsc``: one request per server; returns a
-        ``join() -> {key: (values, flat_indices)}`` callable."""
+        ``join() -> {key: (values, flat_indices)}`` callable. Under
+        ENABLE_P3 it fans out per key (see push_bsc_batch)."""
         assert len(set(keys)) == len(keys), "duplicate keys in one call"
+        if self.cfg.enable_p3:
+            joins = [(k, self.pull_bsc(k, priority=priority - i,
+                                       timeout=timeout))
+                     for i, k in enumerate(keys)]
+
+            def join_all():
+                return {k: j() for k, j in joins}
+
+            return join_all
         per_server: Dict[int, KVPairs] = {}
         server_keys: Dict[int, List[int]] = {}
         for k in keys:

@@ -118,3 +118,72 @@ def test_duplicate_keys_rejected_loudly():
         topo.run_workers(worker, include_master=master_init, timeout=120)
     finally:
         topo.stop()
+
+
+def test_p3_list_form_fans_out_per_key():
+    """Under ENABLE_P3 the list forms fan out to per-key prioritized
+    messages (coalescing would defeat the priority send thread); the
+    results must still be exact, and the sparse batch paths must fan
+    out the same way."""
+    topo = InProcessHiPS(num_parties=2, workers_per_party=1,
+                         extra_cfg={"enable_p3": True,
+                                    "bigarray_bound": 8}).start()
+    try:
+        def master_init(kv):
+            kv.set_optimizer(SGD(learning_rate=1.0))
+            for k, n in ((0, 20), (1, 6)):
+                kv.init(k, np.zeros(n, np.float32))
+            kv.wait()
+
+        def worker(kv):
+            assert kv.cfg.enable_p3
+            outs = [np.zeros(20, np.float32), np.zeros(6, np.float32)]
+            for k, o in zip((0, 1), outs):
+                kv.init(k, o.copy())
+                kv.pull(k, out=o)
+            kv.wait()
+            for r in range(1, 3):
+                kv.push([0, 1], [np.ones(20, np.float32),
+                                 np.ones(6, np.float32)])
+                kv.pull([0, 1], out=outs)
+                kv.wait()
+                for o in outs:
+                    np.testing.assert_allclose(o, -2.0 * r)
+
+        topo.run_workers(worker, include_master=master_init, timeout=300)
+    finally:
+        topo.stop()
+
+
+def test_p3_sparse_batch_fans_out_per_key():
+    """The sparse batch paths under ENABLE_P3 fan out per key like the
+    dense list form (aggregator mode: no server optimizer, the
+    pull-back is the aggregated selection)."""
+    topo = InProcessHiPS(num_parties=2, workers_per_party=1,
+                         extra_cfg={"enable_p3": True,
+                                    "bigarray_bound": 8}).start()
+    try:
+        def master_init(kv):
+            for k, n in ((0, 20), (1, 6)):
+                kv.init(k, np.zeros(n, np.float32))
+            kv.wait()
+
+        def worker(kv):
+            assert kv.cfg.enable_p3
+            for k, n in ((0, 20), (1, 6)):
+                kv.init(k, np.zeros(n, np.float32))
+                kv.pull(k, out=np.zeros(n, np.float32))
+            kv.wait()
+            kv.push_bsc_batch([0, 1],
+                              [np.array([1.0], np.float32)] * 2,
+                              [np.array([3], np.int64)] * 2)
+            agg = kv.pull_bsc_batch([0, 1])()
+            for k in (0, 1):
+                avals, aidx = agg[k]
+                dense = np.zeros(20 if k == 0 else 6, np.float32)
+                dense[aidx] = avals
+                np.testing.assert_allclose(dense[3], 2.0)  # 2 workers
+
+        topo.run_workers(worker, include_master=master_init, timeout=300)
+    finally:
+        topo.stop()

@@ -11,13 +11,31 @@ x=jax.device_put(np.ones(8,'f4')); jax.block_until_ready(x); \
 import sys; sys.exit(0 if 'tpu' in jax.devices()[0].device_kind.lower() else 1)" \
       > /dev/null 2>&1; then
     echo "$(date -Is) tunnel healthy — capturing" >> /tmp/chip_watch.log
-    timeout 3600 python bench.py > CHIP_CAPTURE_BENCH.json \
+    timeout 3600 python bench.py > CHIP_CAPTURE_BENCH.json.tmp \
         2>> /tmp/chip_watch.log
-    echo "bench rc=$?" >> /tmp/chip_watch.log
+    bench_rc=$?
+    echo "bench rc=$bench_rc" >> /tmp/chip_watch.log
     timeout 1800 python tools/attention_bench.py --sweep-blocks \
-        > CHIP_CAPTURE_ATTENTION.jsonl 2>> /tmp/chip_watch.log
-    echo "sweep rc=$?" >> /tmp/chip_watch.log
-    exit 0
+        > CHIP_CAPTURE_ATTENTION.jsonl.tmp 2>> /tmp/chip_watch.log
+    sweep_rc=$?
+    echo "sweep rc=$sweep_rc" >> /tmp/chip_watch.log
+    # publish only complete captures; a tunnel flap mid-capture leaves
+    # the watch running for the next recovery instead of exiting with
+    # truncated files
+    ok=1
+    if [ "$bench_rc" -eq 0 ] && [ -s CHIP_CAPTURE_BENCH.json.tmp ]; then
+      mv CHIP_CAPTURE_BENCH.json.tmp CHIP_CAPTURE_BENCH.json
+    else
+      rm -f CHIP_CAPTURE_BENCH.json.tmp; ok=0
+    fi
+    if [ "$sweep_rc" -eq 0 ] && [ -s CHIP_CAPTURE_ATTENTION.jsonl.tmp ]; then
+      mv CHIP_CAPTURE_ATTENTION.jsonl.tmp CHIP_CAPTURE_ATTENTION.jsonl
+    else
+      rm -f CHIP_CAPTURE_ATTENTION.jsonl.tmp; ok=0
+    fi
+    [ "$ok" -eq 1 ] && exit 0
+    echo "$(date -Is) capture incomplete; resuming watch" \
+        >> /tmp/chip_watch.log
   fi
   sleep 600
 done
