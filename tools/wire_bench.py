@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Protocol-only round time: batched list wire vs per-key messages.
+
+Times full two-tier FSA rounds (push + pull + wait, every byte over
+the real transport) with compute excluded, on an in-process 2-party
+topology. The batched wire sends ONE message per server per direction
+(kvstore.server._BatchResponder merges the per-key acks); per-key
+sends 2*n_keys messages. Reproduces the PERF.md captures:
+
+    python tools/wire_bench.py --layout cnn          # 10 keys, 178k
+    python tools/wire_bench.py --layout transformer  # 75 keys, mixed
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+LAYOUTS = {
+    "cnn": [(800,), (32,), (25600,), (64,), (51200,), (128,), (65536,),
+            (10,), (1176,), (84,)],
+    "transformer": None,   # 75 keys, mixed sizes (seeded below)
+}
+
+
+def run(shapes, batched: bool, rounds: int) -> float:
+    from geomx_tpu.optimizer import SGD
+    from geomx_tpu.simulate import InProcessHiPS
+
+    keys = list(range(len(shapes)))
+    topo = InProcessHiPS(num_parties=2, workers_per_party=1).start()
+    times = {}
+    try:
+        def master_init(kv):
+            kv.set_optimizer(SGD(learning_rate=0.01))
+            for k, sh in zip(keys, shapes):
+                kv.init(k, np.zeros(sh, np.float32))
+            kv.wait()
+
+        def worker(kv):
+            outs = [np.zeros(sh, np.float32) for sh in shapes]
+            grads = [np.ones(sh, np.float32) for sh in shapes]
+            for k, o in zip(keys, outs):
+                kv.init(k, o.copy())
+                kv.pull(k, out=o)
+            kv.wait()
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                if batched:
+                    kv.push(keys, grads)
+                    kv.pull(keys, out=outs)
+                else:
+                    for k, g, o in zip(keys, grads, outs):
+                        kv.push(k, g)
+                        kv.pull(k, out=o)
+                kv.wait()
+            times[id(kv)] = (time.perf_counter() - t0) / rounds * 1e3
+
+        topo.run_workers(worker, include_master=master_init, timeout=600)
+    finally:
+        topo.stop()
+    return max(times.values())
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--layout", choices=sorted(LAYOUTS), default="cnn")
+    ap.add_argument("--rounds", type=int, default=100)
+    args = ap.parse_args()
+
+    shapes = LAYOUTS[args.layout]
+    if shapes is None:
+        rng = np.random.RandomState(0)
+        shapes = [(int(s),)
+                  for s in rng.choice([64, 512, 2048, 8192], 75)]
+    per_key = run(shapes, batched=False, rounds=args.rounds)
+    batched = run(shapes, batched=True, rounds=args.rounds)
+    print(json.dumps({
+        "layout": args.layout, "keys": len(shapes),
+        "per_key_ms_per_round": round(per_key, 2),
+        "batched_ms_per_round": round(batched, 2),
+        "speedup": round(per_key / batched, 2)}))
+
+
+if __name__ == "__main__":
+    main()
