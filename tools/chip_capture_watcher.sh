@@ -1,41 +1,57 @@
 #!/bin/bash
 # Detached watcher: probe the TPU tunnel; on recovery, capture the full
 # bench + flash block-size sweep into the repo so the round records real
-# chip numbers even if recovery happens unattended. Safe to re-run;
-# exits after one successful capture or when the deadline passes.
+# chip numbers even if recovery happens unattended.
+#
+# The bench runs with --resume against a persistent partial file: every
+# completed phase survives a tunnel flap, so successive recovery windows
+# FILL IN the capture instead of restarting it. Safe to re-run; exits
+# after one complete capture or when the deadline passes.
 cd /root/repo
-DEADLINE=$(( $(date +%s) + ${WATCH_HOURS:-8} * 3600 ))
+PARTIAL=.bench_chip_partial.json
+DEADLINE=$(( $(date +%s) + ${WATCH_HOURS:-10} * 3600 ))
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if timeout 120 python -c "import jax, numpy as np; \
 x=jax.device_put(np.ones(8,'f4')); jax.block_until_ready(x); \
 import sys; sys.exit(0 if 'tpu' in jax.devices()[0].device_kind.lower() else 1)" \
       > /dev/null 2>&1; then
     echo "$(date -Is) tunnel healthy — capturing" >> /tmp/chip_watch.log
-    timeout 3600 python bench.py > CHIP_CAPTURE_BENCH.json.tmp \
-        2>> /tmp/chip_watch.log
+    timeout 3600 python bench.py --resume --partial "$PARTIAL" \
+        --budget 3300 > CHIP_CAPTURE_BENCH.json.tmp 2>> /tmp/chip_watch.log
     bench_rc=$?
     echo "bench rc=$bench_rc" >> /tmp/chip_watch.log
-    timeout 1800 python tools/attention_bench.py --sweep-blocks \
-        > CHIP_CAPTURE_ATTENTION.jsonl.tmp 2>> /tmp/chip_watch.log
-    sweep_rc=$?
-    echo "sweep rc=$sweep_rc" >> /tmp/chip_watch.log
-    # publish only complete captures; a tunnel flap mid-capture leaves
-    # the watch running for the next recovery instead of exiting with
-    # truncated files
-    ok=1
+    # publish only COMPLETE captures (rc=0): a degraded run must never
+    # overwrite a previously complete CHIP_CAPTURE_BENCH.json. Errored
+    # phases stay errored in the partial and are retried on the next
+    # recovery window.
     if [ "$bench_rc" -eq 0 ] && [ -s CHIP_CAPTURE_BENCH.json.tmp ]; then
       mv CHIP_CAPTURE_BENCH.json.tmp CHIP_CAPTURE_BENCH.json
     else
-      rm -f CHIP_CAPTURE_BENCH.json.tmp; ok=0
+      rm -f CHIP_CAPTURE_BENCH.json.tmp
     fi
-    if [ "$sweep_rc" -eq 0 ] && [ -s CHIP_CAPTURE_ATTENTION.jsonl.tmp ]; then
-      mv CHIP_CAPTURE_ATTENTION.jsonl.tmp CHIP_CAPTURE_ATTENTION.jsonl
-    else
-      rm -f CHIP_CAPTURE_ATTENTION.jsonl.tmp; ok=0
+    if [ "$bench_rc" -ne 0 ]; then
+      echo "$(date -Is) capture incomplete; resuming watch" \
+          >> /tmp/chip_watch.log
+      sleep 600
+      continue
     fi
-    [ "$ok" -eq 1 ] && exit 0
-    echo "$(date -Is) capture incomplete; resuming watch" \
-        >> /tmp/chip_watch.log
+    if [ ! -s CHIP_CAPTURE_ATTENTION.jsonl ]; then
+      timeout 1800 python tools/attention_bench.py --sweep-blocks \
+          > CHIP_CAPTURE_ATTENTION.jsonl.tmp 2>> /tmp/chip_watch.log
+      sweep_rc=$?
+      echo "sweep rc=$sweep_rc" >> /tmp/chip_watch.log
+      if [ "$sweep_rc" -eq 0 ] && [ -s CHIP_CAPTURE_ATTENTION.jsonl.tmp ]; then
+        mv CHIP_CAPTURE_ATTENTION.jsonl.tmp CHIP_CAPTURE_ATTENTION.jsonl
+      else
+        rm -f CHIP_CAPTURE_ATTENTION.jsonl.tmp
+        echo "$(date -Is) sweep incomplete; resuming watch" \
+            >> /tmp/chip_watch.log
+        sleep 600
+        continue
+      fi
+    fi
+    echo "$(date -Is) capture complete" >> /tmp/chip_watch.log
+    exit 0
   fi
   sleep 600
 done
