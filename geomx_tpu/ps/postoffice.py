@@ -105,7 +105,7 @@ class Postoffice:
         )
 
     def finalize(self, do_barrier: bool = True,
-                 barrier_timeout: float = 600.0) -> None:
+                 barrier_timeout: float = None) -> None:
         """Exit protocol: one ALL-group barrier, then teardown.
 
         Every tier member performs exactly two ALL-group barriers over its
@@ -114,6 +114,8 @@ class Postoffice:
         """
         if not self._started:
             return
+        if barrier_timeout is None:
+            barrier_timeout = self.cfg.barrier_timeout_s
         if do_barrier:
             try:
                 self.barrier(base.ALL_GROUP, timeout=barrier_timeout)
@@ -204,8 +206,9 @@ class Postoffice:
 
     # -- barriers (reference: postoffice.h:167) --------------------------
 
-    def barrier(self, group: int, timeout: float = 300.0) -> None:
-        self.van.barrier(group, timeout)
+    def barrier(self, group: int, timeout: float = None) -> None:
+        self.van.barrier(group, timeout if timeout is not None
+                         else self.cfg.barrier_timeout_s)
 
     # -- key ranges (reference: postoffice.h:76 GetServerKeyRanges) ------
 

@@ -15,10 +15,18 @@ host<->device link carries only:
   HiPS tier (bounded by workers x k), as one fixed-size padded array so
   the jitted apply never retraces.
 
-Indices travel as int32 BITCAST into the float32 payload
-(lax.bitcast_convert_type), so any index a flat int32 can address is
-exact — models up to 2^31 parameters per trainer (the round-3 float32
-mantissa packing capped this at 2^24).
+The packed wire is an INT32 array: float payloads (loss, values) are
+bitcast int32-wards (lax.bitcast_convert_type) and indices ride as
+native int32, so any index a flat int32 can address is exact — models
+up to 2^31 parameters per trainer (the round-3 float32 mantissa packing
+capped this at 2^24). The direction of the bitcast is load-bearing: the
+round-4 chip capture collapsed to chance accuracy because the inverse
+packing (indices bitcast INTO a float32 array) produces denormal bit
+patterns for every index < 2^23, and TPU float data movement inside jit
+(the concatenate fusing through the VPU) flushes denormals to zero —
+every scatter landed on coordinate 0. Integer lanes never flush, so the
+int32 packing is bit-exact on every backend (probe:
+tools/chip_sanity.py transfer_bitexact / bitcast_in_jit).
 
 The LAN hop is element-sparse when the kvstore supports it
 (KVStoreDist.push_bsc / pull_bsc — O(k) bytes and host work per key);
@@ -140,16 +148,19 @@ class DeviceResidentTrainer:
             idx = jnp.concatenate(idx_parts)       # model-flat positions
             v = v.at[idx].set(0.0)
             u = u.at[idx].set(0.0)
-            # single packed transfer: [loss, vals(K), idx(K) bitcast f32]
+            # single packed INT32 transfer: [loss, vals(K) bitcast i32,
+            # idx(K)] — int lanes are denormal-safe (module docstring)
             packed = jnp.concatenate(
-                [loss[None].astype(jnp.float32), vals,
-                 jax.lax.bitcast_convert_type(idx, jnp.float32)])
+                [jax.lax.bitcast_convert_type(
+                    loss[None].astype(jnp.float32), jnp.int32),
+                 jax.lax.bitcast_convert_type(vals, jnp.int32),
+                 idx])
             return packed, u, v
 
         @jax.jit
         def apply_sgd(flat, mom, packed):
-            vals = packed[:m]
-            idx = jax.lax.bitcast_convert_type(packed[m:], jnp.int32)
+            vals = jax.lax.bitcast_convert_type(packed[:m], jnp.float32)
+            idx = packed[m:]
             # pad slots carry (val 0.0, idx 0): a scatter-add no-op
             g = jnp.zeros_like(flat).at[idx].add(vals)
             if mom is None:
@@ -172,7 +183,7 @@ class DeviceResidentTrainer:
 
         packed, _u, _v = self._fwd_compress(self._flat, self._u,
                                             self._v, X, y)
-        up = jax.device_put(np.zeros(2 * self._up_cap, np.float32))
+        up = jax.device_put(np.zeros(2 * self._up_cap, np.int32))
         flat2, _mom2 = self._apply(self._flat, self._mom, up)
         jax.block_until_ready((packed, flat2))
 
@@ -185,11 +196,11 @@ class DeviceResidentTrainer:
 
         packed_d, self._u, self._v = self._fwd_compress(
             self._flat, self._u, self._v, X, y)
-        # ONE compact device->host transfer (1 + 2K floats vs total)
+        # ONE compact device->host transfer (1 + 2K int32 vs total)
         packed = np.asarray(packed_d)
-        loss = float(packed[0])
-        vals = packed[1:1 + self._K]
-        idx = packed[1 + self._K:].view(np.int32).astype(np.int64)
+        loss = float(packed[:1].view(np.float32)[0])
+        vals = packed[1:1 + self._K].view(np.float32)
+        idx = packed[1 + self._K:].astype(np.int64)
         if self._sparse_wire:
             ups, upi = self._kv_round_sparse(vals, idx)
         else:
@@ -202,10 +213,9 @@ class DeviceResidentTrainer:
                 f"aggregated selection ({n}) exceeds the upload capacity "
                 f"({self._up_cap}) — is the PS tier running an optimizer? "
                 "DeviceResidentTrainer requires aggregator mode")
-        up = np.zeros(2 * self._up_cap, np.float32)
-        up[:n] = ups
-        up[self._up_cap:self._up_cap + n] = \
-            upi.astype(np.int32).view(np.float32)
+        up = np.zeros(2 * self._up_cap, np.int32)
+        up[:n] = np.asarray(ups, np.float32).view(np.int32)
+        up[self._up_cap:self._up_cap + n] = upi.astype(np.int32)
         self._flat, self._mom = self._apply(
             self._flat, self._mom, jax.device_put(up))
         return loss

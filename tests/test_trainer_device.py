@@ -131,3 +131,37 @@ def test_warmup_compiles_without_state_change():
 def _grad_fn_16(leaves, X, y):
     w = leaves[0]
     return 0.5 * jnp.sum(w * w), [w + 1.0]
+
+
+def test_packed_wire_is_int32_and_index_exact():
+    """Round-4 chip regression: the packed device<->host payload must be
+    an INT32 array (floats bitcast int-wards), never float32 with
+    indices bitcast float-wards. Indices < 2^23 bitcast to float32 are
+    denormals, and TPU float data movement inside jit flushes denormals
+    to zero — on the r04 capture every index collapsed to 0 and headline
+    accuracy fell to chance (BENCH_r04.json hips_bsc_cnn 0.0967).
+    CPU can't reproduce the flush, so this asserts the wire CONTRACT:
+    dtype int32 end-to-end and bit-exact recovery of small indices."""
+    from geomx_tpu.kvstore import create as kv_create
+
+    rng = np.random.default_rng(3)
+    w = rng.standard_normal(500).astype(np.float32)
+
+    def gfn(leaves, X, y):
+        return jnp.sum(leaves[0]), [jnp.asarray(w)]
+
+    kv = kv_create("local")
+    tr = DeviceResidentTrainer([np.zeros(500, np.float32)], kv, gfn,
+                               threshold=0.01, learning_rate=1.0)
+    packed, _u, _v = tr._fwd_compress(tr._flat, tr._u, tr._v,
+                                      jnp.asarray(0.0), None)
+    assert np.asarray(packed).dtype == np.int32
+    k = tr.k
+    p = np.asarray(packed)
+    idx = p[1 + k:]
+    vals = p[1:1 + k].view(np.float32)
+    # exact top-k of the rigged gradient: u=g, v=g -> top-|g| coords
+    expect = np.argsort(-np.abs(w), kind="stable")[:k]
+    assert set(idx.tolist()) == set(expect.tolist())
+    np.testing.assert_array_equal(np.sort(np.abs(vals)),
+                                  np.sort(np.abs(w[expect])))
