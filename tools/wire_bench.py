@@ -55,8 +55,7 @@ def run(shapes, batched: bool, rounds: int) -> float:
             t0 = time.perf_counter()
             for _ in range(rounds):
                 if batched:
-                    kv.push(keys, grads)
-                    kv.pull(keys, out=outs)
+                    kv.push_pull(keys, grads, out=outs)
                 else:
                     for k, g, o in zip(keys, grads, outs):
                         kv.push(k, g)
