@@ -32,6 +32,9 @@ class Command:
     GLOBAL_BARRIER = 8            # cross-party worker barrier (via servers)
     GET_OPTIMIZER_STATES = 9      # fetch the server-side updater's states
     SET_OPTIMIZER_STATES = 10     # restore the server-side updater's states
+    ESYNC_STATE = 11              # ESync state-server report -> step count
+    #                               (beyond parity: reference README.md:45
+    #                               documents ESync but ships no code)
 
 
 # Data-plane cmd values carried in push meta.head.

@@ -883,19 +883,10 @@ class KVStoreDist(KVStore):
 
         return join
 
-    def push_bsc_batch(self, keys, values_list, indices_list,
-                       priority: int = 0) -> None:
-        """Batched ``push_bsc``: one message per server carrying every
-        key's sparse selection (same countdown-merged ack as the dense
-        batched wire). Under ENABLE_P3 it fans out per key with
-        descending priority, like the dense list form — one coalesced
-        message would defeat the priority send thread's interleaving."""
-        assert len(set(keys)) == len(keys), "duplicate keys in one round"
-        if self.cfg.enable_p3:
-            for i, (k, v, ix) in enumerate(zip(keys, values_list,
-                                               indices_list)):
-                self.push_bsc(k, v, ix, priority=priority - i)
-            return
+    def _prepare_bsc_shards(self, keys, values_list, indices_list):
+        """Validate per-key sparse selections and partition them into
+        one KVPairs per server (shared by the separate and combined BSC
+        wire sends)."""
         per_server: Dict[int, KVPairs] = {}
         server_keys: Dict[int, List[int]] = {}
         prepared = []
@@ -921,6 +912,23 @@ class KVStoreDist(KVStore):
                 kvs.totals.append(sh.total)
                 kvs.lens.append(sh.length)
                 server_keys.setdefault(sh.server_rank, []).append(k)
+        return per_server, server_keys
+
+    def push_bsc_batch(self, keys, values_list, indices_list,
+                       priority: int = 0) -> None:
+        """Batched ``push_bsc``: one message per server carrying every
+        key's sparse selection (same countdown-merged ack as the dense
+        batched wire). Under ENABLE_P3 it fans out per key with
+        descending priority, like the dense list form — one coalesced
+        message would defeat the priority send thread's interleaving."""
+        assert len(set(keys)) == len(keys), "duplicate keys in one round"
+        if self.cfg.enable_p3:
+            for i, (k, v, ix) in enumerate(zip(keys, values_list,
+                                               indices_list)):
+                self.push_bsc(k, v, ix, priority=priority - i)
+            return
+        per_server, server_keys = self._prepare_bsc_shards(
+            keys, values_list, indices_list)
         self._send_batch_pushes(per_server, server_keys, priority)
 
     def push_pull_bsc_batch(self, keys, values_list, indices_list,
@@ -938,31 +946,8 @@ class KVStoreDist(KVStore):
                                 priority=priority)
             return self.pull_bsc_batch(keys, priority=priority,
                                        timeout=timeout)
-        per_server: Dict[int, KVPairs] = {}
-        server_keys: Dict[int, List[int]] = {}
-        prepared = []
-        for k, values, indices in zip(keys, values_list, indices_list):
-            vals = np.ascontiguousarray(values, dtype=np.float32).ravel()
-            idx = np.asarray(indices, dtype=np.int64).ravel()
-            assert vals.size == idx.size, "values/indices mismatch"
-            info = self._key_info.get(k)
-            assert info is not None, f"push_bsc of key {k} before init"
-            if idx.size and (idx.min() < 0 or idx.max() >= info.total):
-                raise IndexError(
-                    f"push_bsc: indices out of range for key {k}")
-            prepared.append((k, vals, idx, info))
-        for k, vals, idx, info in prepared:
-            for sh in info.shards:
-                sel = (idx >= sh.offset) & (idx < sh.offset + sh.length)
-                kvs = per_server.setdefault(sh.server_rank,
-                                            KVPairs(compr="bsc"))
-                kvs.keys.append(k)
-                kvs.vals.append(vals[sel])
-                kvs.aux.append((idx[sel] - sh.offset).astype(np.int32))
-                kvs.offsets.append(sh.offset)
-                kvs.totals.append(sh.total)
-                kvs.lens.append(sh.length)
-                server_keys.setdefault(sh.server_rank, []).append(k)
+        per_server, server_keys = self._prepare_bsc_shards(
+            keys, values_list, indices_list)
         parts: Dict[int, List] = {k: [] for k in keys}
         fails: List[str] = []
         done = threading.Event()
@@ -1268,6 +1253,21 @@ class KVStoreDist(KVStore):
     def _send_command(self, head: int, body: str) -> None:
         ts = self.kvw.request(head, body, psbase.SERVER_GROUP)
         self.kvw.wait(ts, 120.0)
+
+    def esync_state(self, tau_s: float, c_s: float) -> int:
+        """Report this worker's measured per-step compute time and sync
+        round-trip to the ESync state server (rank-0 local PS); returns
+        the assigned local step count M_i (geomx_tpu.esync; beyond
+        parity — reference README.md:45 documents ESync, ships no
+        code)."""
+        import json
+
+        ts = self.kvw.request(Command.ESYNC_STATE,
+                              json.dumps({"tau": tau_s, "c": c_s}),
+                              psbase.server_rank_to_id(0))
+        self.kvw.wait(ts, 120.0)
+        bodies = self.kvw.take_response_bodies(ts)
+        return int(bodies[0]) if bodies else 1
 
     def barrier(self, is_global: bool = False) -> None:
         if is_global:

@@ -283,6 +283,12 @@ class KVStoreDistServer:
         self._g_rounds: Dict[Tuple[int, int], int] = {}
         # per-transport-thread forward collector (batched WAN hop)
         self._fwd_tls = threading.local()
+        # ESync state server (Command.ESYNC_STATE; geomx_tpu.esync) —
+        # constructed eagerly: lazy init would be a check-then-set race
+        # across per-connection reader threads
+        from geomx_tpu.esync import ESyncStateServer
+
+        self._esync = ESyncStateServer()
         # global-server: party size per global-worker sender, for FSA round
         # counting + uniformity validation (round-2 Weak #5)
         self._party_nsrv = 1
@@ -1410,6 +1416,13 @@ class KVStoreDistServer:
             return
         if head == Command.GLOBAL_BARRIER:
             self._handle_global_barrier(req, srv)
+            return
+        if head == Command.ESYNC_STATE:
+            # ESync state server (geomx_tpu.esync): hosted on the party's
+            # rank-0 PS per the paper's co-located deployment; workers
+            # report (tau, c), the response body carries their next local
+            # step count
+            srv.response(req, body=self._esync.handle(body, req.sender))
             return
         if head == Command.GET_OPTIMIZER_STATES:
             # the LIVE updater runs where updates apply: the GLOBAL tier in

@@ -1,0 +1,6 @@
+#!/bin/bash
+# ESync: state-server-balanced local steps + synchronous model averaging
+# (beyond parity — reference README.md:45 documents ESync, ships no code)
+cd "$(dirname "$0")"
+source ./hips_env.sh
+launch_hips "$REPO_DIR/examples/cnn_esync.py" --cpu "$@"

@@ -1,0 +1,90 @@
+"""ESync (geomx_tpu.esync): state-server step balancing + synchronous
+model averaging. Beyond parity — the reference documents the algorithm
+("to be integrated", reference README.md:45) but ships no code; the
+semantics here follow the cited paper (Li et al., IEEE TSC 2020)."""
+
+import time
+
+import numpy as np
+
+from geomx_tpu.esync import ESyncStateServer, ESyncTrainer
+from geomx_tpu.optimizer import SGD
+from geomx_tpu.simulate import InProcessHiPS
+
+
+def test_state_server_balances_reach_time():
+    ss = ESyncStateServer()
+    # slow worker: 100 ms/step; fast worker: 10 ms/step; equal RTT
+    assert ss.report(1, 0.1, 0.01) == 1     # alone -> 1 step
+    m_fast = ss.report(2, 0.01, 0.01)
+    # fast worker fills the slow worker's reach time: ~(0.11-0.01)/0.01
+    assert 8 <= m_fast <= 10
+    # the slow worker stays at 1 local step
+    assert ss.report(1, 0.1, 0.01) == 1
+
+
+def test_state_server_cap_and_smoothing():
+    ss = ESyncStateServer(cap=4)
+    ss.report(1, 1.0, 0.0)                   # very slow peer
+    assert ss.report(2, 0.001, 0.0) == 4     # capped
+    # EMA: a transiently fast report does not whipsaw to the extreme
+    ss2 = ESyncStateServer()
+    ss2.report(1, 0.1, 0.0)
+    ss2.report(2, 0.1, 0.0)
+    m1 = ss2.report(2, 0.01, 0.0)            # smoothed tau ~0.055
+    assert m1 <= 2
+
+
+def _quad_grad(target):
+    def grad_fn(leaves, X, y):
+        # quadratic bowl: loss = 0.5*sum((w - target)^2)
+        grads = [l - t for l, t in zip(leaves, target)]
+        loss = sum(0.5 * float(np.sum(g * g)) for g in grads)
+        return loss, grads
+    return grad_fn
+
+
+def test_esync_trains_and_balances_heterogeneity():
+    """Two workers, one 5x slower: the fast one gets more local steps,
+    replicas leave every sync identical, and the model converges."""
+    # ONE party, two workers: ESync is intra-domain (the paper balances
+    # workers within a data center; each party's rank-0 PS hosts its own
+    # state server)
+    topo = InProcessHiPS(num_parties=1, workers_per_party=2).start()
+    target = [np.full((8,), 3.0, np.float32), np.full((3,), -2.0,
+                                                      np.float32)]
+    results = {}
+    try:
+        def master_init(kv):
+            for i, t in enumerate(target):
+                kv.init(i, np.zeros_like(t))
+            kv.wait()
+
+        def worker(kv):
+            widx = 0 if kv is topo.workers[0] else 1
+            slowdown = 0.05 if widx == 0 else 0.0
+
+            def grad_fn(leaves, X, y):
+                time.sleep(slowdown)
+                return _quad_grad(target)(leaves, X, y)
+
+            tr = ESyncTrainer([np.zeros_like(t) for t in target], kv,
+                              grad_fn, SGD(learning_rate=0.3))
+            batches = [(None, None)]
+            losses = [tr.round(batches) for _ in range(12)]
+            results[widx] = (tr, losses)
+
+        topo.run_workers(worker, include_master=master_init, timeout=300)
+    finally:
+        topo.stop()
+    (tr0, l0), (tr1, l1) = results[0], results[1]
+    # replicas identical after the final sync
+    for a, b in zip(tr0.leaves, tr1.leaves):
+        np.testing.assert_array_equal(a, b)
+    # converged toward the target
+    assert l0[-1] < l0[0] / 10
+    # the fast worker ran MORE local steps than the slow one; the slow
+    # worker's count may wobble 1-2 under suite-load timing noise (a
+    # sync-RTT spike legitimately raises its assignment), so the strong
+    # claim is the RATIO, not an exact count
+    assert tr1.local_steps_run > 2 * tr0.local_steps_run
