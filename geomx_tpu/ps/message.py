@@ -34,8 +34,15 @@ MAGIC = 0x47454F4D  # "GEOM"
 
 _PREHDR = struct.Struct("<IiBiI")  # magic, recver, flags, priority, meta_len
 _U32 = struct.Struct("<I")
+_U16 = struct.Struct("<H")
+_I64 = struct.Struct("<q")
 
 FLAG_GLOBAL = 0x1
+# meta region is the binary TLV codec below, not JSON (round-4 verdict
+# item 5: JSON meta encode/decode was the largest per-message CPU item
+# on the protocol hot path). Control messages carrying node tables keep
+# JSON — they are rare (bootstrap/barrier) and structurally recursive.
+FLAG_BINMETA = 0x2
 
 
 class Control(enum.IntEnum):
@@ -209,6 +216,146 @@ class Meta:
         return m
 
 
+# ---------------------------------------------------------------------------
+# Binary meta codec (FLAG_BINMETA): field-id TLV over the Meta dataclass.
+#
+# Layout: repeated { u8 field_id | payload }, only non-default fields
+# encoded (like the JSON path's default omission). Payload by kind:
+#   i  -> i64     b -> u8      f -> f64     s -> u32 len + utf-8
+#   I  -> u32 len + big-endian magnitude bytes (non-negative bigint —
+#         aux_mask carries one bit per key, arbitrarily many keys)
+#   ls -> u32 count, each (u16 len + utf-8)
+#   lli-> u32 count, each (u16 ndim + i64 * ndim)
+# `nodes` is deliberately NOT encodable: control messages carrying node
+# tables (bootstrap, barrier bookkeeping) fall back to JSON via pack().
+# Field ids are POSITIONS in _META_FIELDS. The format carries no
+# per-field skip width, so it is NOT cross-version compatible: every
+# node of a deployment must run the same build (the launch scripts
+# ship one tree to all roles, and the reference's protobuf meta makes
+# the same same-build assumption in practice). Reorders/appends are
+# fine within one build; a mixed-version cluster is not supported.
+# ---------------------------------------------------------------------------
+
+_META_FIELDS: List[Tuple[str, str]] = [
+    ("sender", "i"), ("app_id", "i"), ("customer_id", "i"),
+    ("timestamp", "i"), ("request", "b"), ("push", "b"), ("pull", "b"),
+    ("simple_app", "b"), ("head", "i"), ("body", "s"),
+    ("control_cmd", "i"), ("barrier_group", "i"), ("msg_sig", "i"),
+    ("dtypes", "ls"), ("shapes", "lli"), ("version", "i"), ("key", "i"),
+    ("iters", "i"), ("compr", "s"), ("first_key", "i"), ("seq", "i"),
+    ("seq_begin", "i"), ("seq_end", "i"), ("msg_type", "i"),
+    ("val_bytes", "i"), ("total_bytes", "i"), ("channel", "i"),
+    ("tos", "i"), ("val_dtype", "s"), ("dgt_scale", "f"), ("dgt_n", "i"),
+    ("lossy", "b"), ("num_merge", "i"), ("party_nsrv", "i"),
+    ("aux_mask", "I"), ("aux_len", "i"),
+]
+_META_DEFAULTS = {f.name: ([] if isinstance(f.default,
+                                            dataclasses._MISSING_TYPE)
+                           else f.default)
+                  for f in dataclasses.fields(Meta)}
+_F64 = struct.Struct("<d")
+
+
+def _encode_meta_bin(meta: "Meta") -> bytes:
+    out: List[bytes] = []
+    ap = out.append
+    for fid, (name, kind) in enumerate(_META_FIELDS):
+        v = getattr(meta, name)
+        if v == _META_DEFAULTS[name]:
+            continue
+        ap(bytes((fid,)))
+        if kind == "i":
+            ap(_I64.pack(v))
+        elif kind == "b":
+            ap(b"\x01" if v else b"\x00")
+        elif kind == "f":
+            ap(_F64.pack(v))
+        elif kind == "s":
+            sb = v.encode()
+            ap(_U32.pack(len(sb)))
+            ap(sb)
+        elif kind == "I":
+            bb = v.to_bytes((v.bit_length() + 7) // 8 or 1, "big")
+            ap(_U32.pack(len(bb)))
+            ap(bb)
+        elif kind == "ls":
+            ap(_U32.pack(len(v)))
+            for s in v:
+                sb = s.encode()
+                ap(_U16.pack(len(sb)))
+                ap(sb)
+        else:  # lli
+            ap(_U32.pack(len(v)))
+            for row in v:
+                ap(_U16.pack(len(row)))
+                for x in row:
+                    ap(_I64.pack(x))
+    return b"".join(out)
+
+
+def _decode_meta_bin(buf) -> "Meta":
+    m = Meta()
+    off = 0
+    n = len(buf)
+    mv = memoryview(buf)
+    while off < n:
+        fid = mv[off]
+        off += 1
+        name, kind = _META_FIELDS[fid]
+        if kind == "i":
+            (v,) = _I64.unpack_from(mv, off)
+            off += 8
+        elif kind == "b":
+            v = bool(mv[off])
+            off += 1
+        elif kind == "f":
+            (v,) = _F64.unpack_from(mv, off)
+            off += 8
+        elif kind == "s":
+            (ln,) = _U32.unpack_from(mv, off)
+            off += 4
+            v = bytes(mv[off:off + ln]).decode()
+            off += ln
+        elif kind == "I":
+            (ln,) = _U32.unpack_from(mv, off)
+            off += 4
+            v = int.from_bytes(bytes(mv[off:off + ln]), "big")
+            off += ln
+        elif kind == "ls":
+            (cnt,) = _U32.unpack_from(mv, off)
+            off += 4
+            v = []
+            for _ in range(cnt):
+                (ln,) = _U16.unpack_from(mv, off)
+                off += 2
+                v.append(bytes(mv[off:off + ln]).decode())
+                off += ln
+        else:  # lli
+            (cnt,) = _U32.unpack_from(mv, off)
+            off += 4
+            v = []
+            for _ in range(cnt):
+                (ndim,) = _U16.unpack_from(mv, off)
+                off += 2
+                row = [_I64.unpack_from(mv, off + 8 * j)[0]
+                       for j in range(ndim)]
+                off += 8 * ndim
+                v.append(row)
+        setattr(m, name, v)
+    return m
+
+
+def _decode_meta(meta_b, flags: int) -> "Meta":
+    if flags & FLAG_BINMETA:
+        try:
+            return _decode_meta_bin(meta_b)
+        except (struct.error, IndexError, UnicodeDecodeError) as e:
+            # the van's reader loop drops connections on ValueError; a
+            # garbled meta region must not kill the reader thread
+            raise ValueError(f"malformed binary meta: {e}") from e
+    return Meta.from_dict(json.loads(bytes(meta_b).decode()))
+
+
 @dataclasses.dataclass
 class Message:
     """Meta + zero or more binary data parts.
@@ -224,8 +371,15 @@ class Message:
     # -- framing ---------------------------------------------------------
 
     def pack(self) -> bytes:
-        meta_b = json.dumps(self.meta.to_dict(), separators=(",", ":")).encode()
         flags = FLAG_GLOBAL if self.meta.is_global else 0
+        if self.meta.nodes:
+            # node tables (bootstrap/topology control) stay JSON: rare,
+            # recursive, and debuggable with a packet dump
+            meta_b = json.dumps(self.meta.to_dict(),
+                                separators=(",", ":")).encode()
+        else:
+            meta_b = _encode_meta_bin(self.meta)
+            flags |= FLAG_BINMETA
         out = [
             _PREHDR.pack(MAGIC, self.meta.recver, flags, self.meta.priority, len(meta_b)),
             meta_b,
@@ -243,7 +397,7 @@ class Message:
         if magic != MAGIC:
             raise ValueError(f"bad frame magic {magic:#x}")
         off = _PREHDR.size
-        meta = Meta.from_dict(json.loads(buf[off:off + meta_len].decode()))
+        meta = _decode_meta(buf[off:off + meta_len], flags)
         meta.recver = recver
         meta.priority = priority
         meta.is_global = bool(flags & FLAG_GLOBAL)
@@ -311,7 +465,7 @@ def read_message(sock) -> Optional[Tuple["Message", int]]:
             return None
         data.append(payload)
         total += _U32.size + n
-    meta = Meta.from_dict(json.loads(meta_b.decode()))
+    meta = _decode_meta(meta_b, flags)
     meta.recver = recver
     meta.priority = priority
     meta.is_global = bool(flags & FLAG_GLOBAL)

@@ -218,3 +218,72 @@ if __name__ == "__main__":
     import sys
 
     sys.exit(pytest.main([__file__, "-x", "-q"]))
+
+
+def test_binary_meta_roundtrip_all_field_kinds():
+    """FLAG_BINMETA TLV codec: every field kind survives pack/unpack
+    bit-exactly, and node-table messages stay JSON (round-4 verdict
+    item 5: JSON meta was the hot path's largest per-message CPU)."""
+    from geomx_tpu.ps.message import (FLAG_BINMETA, _PREHDR, Message,
+                                      Meta, Node)
+
+    m = Meta(sender=5, recver=9, app_id=0, customer_id=1, timestamp=42,
+             request=True, push=True, pull=True, head=3, body="cmd",
+             dtypes=["<f4", "<i8"], shapes=[[2, 3], [7]], priority=-2,
+             version=11, key=123, iters=6, compr="bsc", first_key=1,
+             seq=2, seq_begin=0, seq_end=4, msg_type=1, val_bytes=99,
+             total_bytes=400, channel=1, tos=32, val_dtype="<f2",
+             dgt_scale=0.125, dgt_n=77, lossy=True, num_merge=3,
+             party_nsrv=2, aux_mask=0b101, aux_len=3, is_global=True)
+    msg = Message(meta=m)
+    msg.add_array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    wire = msg.pack()
+    flags = _PREHDR.unpack_from(wire, 0)[2]
+    assert flags & FLAG_BINMETA, "data-plane meta must ride the binary codec"
+    back = Message.unpack(wire)
+    for f in ("sender", "recver", "timestamp", "request", "push", "pull",
+              "head", "body", "priority", "version", "key", "iters",
+              "compr", "seq_end", "val_dtype", "dgt_scale", "dgt_n",
+              "lossy", "num_merge", "party_nsrv", "aux_mask", "aux_len",
+              "is_global"):
+        assert getattr(back.meta, f) == getattr(m, f), f
+    # add_array appended a third entry to dtypes/shapes
+    assert back.meta.dtypes == ["<f4", "<i8", "<f4"]
+    assert back.meta.shapes == [[2, 3], [7], [2, 3]]
+    np.testing.assert_array_equal(back.get_array(0),
+                                  np.arange(6, dtype=np.float32).reshape(2, 3))
+
+    # control message with a node table falls back to JSON
+    ctrl = Message(meta=Meta(control_cmd=2, nodes=[Node(id=8, port=99,
+                                                        hostname="h")]))
+    wire2 = ctrl.pack()
+    assert not _PREHDR.unpack_from(wire2, 0)[2] & FLAG_BINMETA
+    back2 = Message.unpack(wire2)
+    assert back2.meta.nodes[0].port == 99
+
+
+def test_binary_meta_large_fields():
+    """Regressions from review: aux_mask with >=64 keys (bigint), body
+    >64 KiB (optimizer-state relays), and malformed binary meta raising
+    ValueError (the reader loop's drop-connection contract)."""
+    import pytest
+
+    from geomx_tpu.ps.message import (FLAG_BINMETA, _PREHDR, Message,
+                                      Meta, _decode_meta)
+
+    mask = int("1" * 200, 2)                  # 200-key batched aux mask
+    big_body = "ab" * 40000                   # 80 KB command payload
+    m = Meta(sender=1, recver=2, timestamp=3, aux_mask=mask,
+             aux_len=200, body=big_body, simple_app=True)
+    back = Message.unpack(Message(meta=m).pack())
+    assert back.meta.aux_mask == mask
+    assert back.meta.aux_len == 200
+    assert back.meta.body == big_body
+
+    wire = bytearray(Message(meta=m).pack())
+    flags = _PREHDR.unpack_from(wire, 0)[2]
+    assert flags & FLAG_BINMETA
+    with pytest.raises(ValueError):
+        _decode_meta(b"\xff\x01\x02", FLAG_BINMETA)   # unknown field id
+    with pytest.raises(ValueError):
+        _decode_meta(b"\x00\x01", FLAG_BINMETA)       # truncated i64
