@@ -122,6 +122,16 @@ def _chip_peak_flops() -> float:
     return 0.0
 
 
+def _probe_batches() -> int:
+    """Accuracy-probe batch-cache size: 8 on the tunnel-attached chip
+    (upload bandwidth bound), 64 on a local backend (round-4 verdict
+    weak #8: acc 1.0 over 8 cached batches is memorization of 2,048
+    images — the CPU control should train on a fuller stream)."""
+    import jax
+
+    return 8 if jax.devices()[0].platform == "tpu" else 64
+
+
 def bench_nokv():
     """Single-chip no-kvstore CNN baseline: img/s + accuracy probe."""
     import jax
@@ -146,13 +156,14 @@ def bench_nokv():
     train_iter, test_iter, _, _ = load_data(bs, 1, 0)
     X0_np, y0_np = next(iter(train_iter))
     # accuracy probe: ACC_ITERS iterations cycling a device-cached
-    # batch set (streaming 100 distinct batches through the tunnel
-    # would make upload bandwidth, not training, the phase cost);
-    # captured AGAIN at BSC_ACC_ITERS so the BSC config's longer probe
-    # has an iteration-matched baseline (the gate must never compare
-    # across different step budgets)
+    # batch set (on the tunnel, streaming 100 distinct batches would
+    # make upload bandwidth the phase cost; a local backend caches a
+    # fuller stream — round-4 verdict weak #8); captured AGAIN at
+    # BSC_ACC_ITERS so the BSC config's longer probe has an
+    # iteration-matched baseline (the gate must never compare across
+    # different step budgets)
     probe = [(jnp.asarray(X), jnp.asarray(y))
-             for X, y in itertools.islice(train_iter, 8)]
+             for X, y in itertools.islice(train_iter, _probe_batches())]
     for it in range(ACC_ITERS):
         X, y = probe[it % len(probe)]
         leaves, opt_state, loss = step(leaves, opt_state, X, y)
@@ -161,19 +172,29 @@ def bench_nokv():
         X, y = probe[it % len(probe)]
         leaves, opt_state, loss = step(leaves, opt_state, X, y)
     acc_long = eval_acc(test_iter, leaves, eval_step)
-    # throughput: steady state on one cached device-resident batch
+    # throughput: steady state on one cached device-resident batch.
+    # Fixed call count + VALUE fence (block_until_ready returns without
+    # waiting on this platform — see bench_transformer_mfu)
     X0, y0 = jnp.asarray(X0_np), jnp.asarray(y0_np)
     for _ in range(5):
         leaves, opt_state, loss = step(leaves, opt_state, X0, y0)
-    jax.block_until_ready(loss)
+    _ = float(loss)
+    t0 = time.perf_counter()
+    _ = float(loss)
+    rtt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(20):
+        leaves, opt_state, loss = step(leaves, opt_state, X0, y0)
+    _ = float(loss)
+    est = max((time.perf_counter() - t0 - rtt) / 20, 1e-7)
+    n_calls = max(int(max(TRIAL_SECONDS / 3, 20 * rtt) / est), 20)
     rates = []
     for _ in range(TRIALS):
-        n, t0 = 0, time.perf_counter()
-        while time.perf_counter() - t0 < TRIAL_SECONDS / 3:
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
             leaves, opt_state, loss = step(leaves, opt_state, X0, y0)
-            n += 1
-        jax.block_until_ready(loss)
-        rates.append(n * bs / (time.perf_counter() - t0))
+        _ = float(loss)
+        rates.append(n_calls * bs / (time.perf_counter() - t0))
     return {"img_s": statistics.median(rates), "acc": float(acc),
             "acc_long": float(acc_long)}
 
@@ -256,7 +277,7 @@ def bench_hips():
             kv.wait()
             train_iter, test_iter, _, _ = load_data(bs, 2, widx)
             batches = [(jnp.asarray(X), jnp.asarray(y))
-                       for X, y in itertools.islice(train_iter, 8)]
+                       for X, y in itertools.islice(train_iter, _probe_batches())]
 
             keylist = list(range(len(leaves)))
 
@@ -388,7 +409,7 @@ def bench_hips_bsc(threshold: float = 0.02, lr: float = 0.05,
                 learning_rate=lr, momentum=momentum)
             train_iter, test_iter, _, _ = load_data(bs, 2, widx)
             batches = [(jnp.asarray(X), jnp.asarray(y))
-                       for X, y in itertools.islice(train_iter, 8)]
+                       for X, y in itertools.islice(train_iter, _probe_batches())]
             with compile_lock:
                 # trace+compile outside the FSA round (tr.step would
                 # barrier on the peer, deadlocking against the lock)
@@ -468,7 +489,7 @@ def bench_hips_hfa(hfa_k1: int = 4, hfa_k2: int = 2):
             kv.wait()
             train_iter, test_iter, _n, _m = load_data(bs, 2, widx)
             batches = [(jnp.asarray(X), jnp.asarray(y))
-                       for X, y in itertools.islice(train_iter, 8)]
+                       for X, y in itertools.islice(train_iter, _probe_batches())]
             nlw = kv.num_workers
 
             def one_iter(i):

@@ -97,6 +97,9 @@ def _safe_unpickle(data: bytes):
     return _SysModulesUnpickler(io.BytesIO(data)).load()
 
 
+from contextlib import nullcontext as _null_ctx
+
+
 class _BatchResponder:
     """One response per multi-key request message.
 
@@ -423,14 +426,28 @@ class KVStoreDistServer:
                             and self.sync_global_mode))
         if collect:
             self._fwd_tls.entries = entries = []
+        # per-operator engine tags (reference: PROFILER_MESSAGE_FUNCNAME
+        # op tagging in the server handler, kvstore_dist_server.h:570):
+        # when the profiler runs, each key's state-machine step records
+        # its own span so a trace shows WHICH key dominated the round
+        tagging = profiler.is_running()
         for i, key in enumerate(kvs.keys):
             off = kvs.offset_of(i)
             total = kvs.total_of(i)
+            if tagging:
+                _tag = profiler.scope(
+                    f"{'push' if req.push else 'pull'}:key{key}",
+                    cat="kvstore.op", offset=off)
+                _tag.__enter__()
             if req.push:
                 val = np.asarray(kvs.vals[i]).ravel()
                 if kvs.compr:
-                    val = self.gc.decompress_push(
-                        kvs.compr, val, kvs.aux[i], kvs.len_of(i) or val.size)
+                    with profiler.scope(f"decompress:{kvs.compr}",
+                                        cat="kvstore.op") if tagging \
+                            else _null_ctx():
+                        val = self.gc.decompress_push(
+                            kvs.compr, val, kvs.aux[i],
+                            kvs.len_of(i) or val.size)
                 total = total or val.size
                 with self._lock:
                     self._key_total[key] = max(self._key_total.get(key, 0),
@@ -455,6 +472,8 @@ class KVStoreDistServer:
                         acts += self._pull_local_store(req, srv, key, off,
                                                        length, kvs.compr,
                                                        aux)
+            if tagging:
+                _tag.__exit__(None, None, None)
         if collect:
             try:
                 for fn in acts:
@@ -892,6 +911,13 @@ class KVStoreDistServer:
         assert self.updater is not None, \
             "_run_updater requires an optimizer; aggregator-mode " \
             "fallbacks are per-site (merged aggregate vs kept weights)"
+        if profiler.is_running():
+            with profiler.scope(f"update:key{key_off[0]}",
+                                cat="kvstore.op"):
+                return self._run_updater_inner(st, key_off, grad)
+        return self._run_updater_inner(st, key_off, grad)
+
+    def _run_updater_inner(self, st: _KeyState, key_off, grad) -> np.ndarray:
         if self.multi_precision and st.dtype != np.float32:
             if st.master is None or st.master.size != st.length:
                 st.master = st.stored.astype(np.float32).ravel()

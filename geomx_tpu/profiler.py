@@ -36,6 +36,7 @@ _events: List[Dict[str, Any]] = []
 _counters: Dict[str, float] = {}
 _state_running = False
 _paused = False
+_device_trace_dir: Optional[str] = None
 _config: Dict[str, Any] = {"filename": "profile.json"}
 _t0 = time.monotonic()
 
@@ -111,14 +112,29 @@ def record(name: str, cat: str, ts_us: float, dur_us: float,
 @contextmanager
 def scope(name: str, cat: str = "geomx", **args):
     """Time a host-side region (the engine-op tag equivalent of the
-    reference's PROFILER_MESSAGE_FUNCNAME, kvstore_dist_server.h:570)."""
+    reference's PROFILER_MESSAGE_FUNCNAME, kvstore_dist_server.h:570).
+
+    While an XLA device trace is active (start_device_trace), the region
+    ALSO emits a ``jax.profiler.TraceAnnotation`` — the TPU-idiomatic
+    analogue of the reference's VTune ITT domain/task bridge
+    (src/profiler/vtune.cc): host protocol events appear aligned on the
+    XLA trace timeline next to the device ops they drive, which is what
+    the ITT instrumentation bought the reference inside VTune."""
     if not is_running():
         yield
         return
     start = _now_us()
+    ann = None
+    if _device_trace_dir is not None:
+        import jax
+
+        ann = jax.profiler.TraceAnnotation(name)
+        ann.__enter__()
     try:
         yield
     finally:
+        if ann is not None:
+            ann.__exit__(None, None, None)
         record(name, cat, start, _now_us() - start, args or None)
 
 
@@ -171,8 +187,6 @@ def reset() -> None:
 # ----------------------------------------------------------------------
 # device-side (XLA) tracing bridge
 # ----------------------------------------------------------------------
-
-_device_trace_dir: Optional[str] = None
 
 
 def start_device_trace(logdir: str) -> None:

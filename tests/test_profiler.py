@@ -145,6 +145,11 @@ def test_worker_drives_server_profiler_end_to_end(tmp_path):
         doc = json.loads(dump.read_text())
         names = {e["name"] for e in doc["traceEvents"]}
         assert "server.push" in names
+        # per-operator engine tags (reference op tagging at
+        # kvstore_dist_server.h:570): key-level spans + the updater span
+        assert "push:key0" in names
+        assert "pull:key0" in names
+        assert "update:key0" in names
     finally:
         kv.close()
         for t in threads:
