@@ -111,11 +111,17 @@ def main():
         for X, y in train_iter:
             loss, grads = grad_step([jnp.asarray(l) for l in leaves],
                                     jnp.asarray(X), jnp.asarray(y))
-            # list form: one batched message per server each way
-            # (per-key prioritized sends under ENABLE_P3)
+            # combined push_pull: ONE message per server per round (the
+            # ack carries the post-round params — bit-identical to
+            # push-then-pull, tests/test_batch_wire.py); falls back to
+            # the two-op sequence under P3/TSEngine/local stores
             keylist = list(range(len(grads)))
-            kv.push(keylist, [np.asarray(g) for g in grads])
-            kv.pull(keylist, out=leaves)
+            if hasattr(kv, "push_pull"):
+                kv.push_pull(keylist, [np.asarray(g) for g in grads],
+                             out=leaves)
+            else:
+                kv.push(keylist, [np.asarray(g) for g in grads])
+                kv.pull(keylist, out=leaves)
             kv.wait()
 
             test_acc = eval_acc(test_iter, leaves, eval_step)

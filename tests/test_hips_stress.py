@@ -364,3 +364,62 @@ def test_stress_batched_wire_under_drop_and_resend():
         _stress_rounds_batched(topo, keys, w0, rounds=8, n_workers=4)
     finally:
         topo.stop()
+
+
+def _stress_rounds_push_pull(topo, keys, w0, rounds, n_workers):
+    """_stress_rounds through the COMBINED push_pull wire (one message
+    per server per round; the countdown-merged ack carries the
+    post-round params)."""
+    topo.master.set_optimizer(SGD(learning_rate=1.0))
+
+    def init_on(kv):
+        for k in keys:
+            kv.init(k, w0[k])
+
+    _parallel([lambda kv=kv: init_on(kv)
+               for kv in topo.workers + [topo.master]])
+
+    def train(kv):
+        for r in range(1, rounds + 1):
+            outs = [np.zeros_like(w0[k]) for k in keys]
+            kv.push_pull(keys, [np.ones_like(w0[k]) for k in keys],
+                         out=outs)
+            kv.wait()
+            for k, out in zip(keys, outs):
+                np.testing.assert_allclose(
+                    out, w0[k] - n_workers * r,
+                    err_msg=f"key {k} round {r}")
+
+    _parallel([lambda kv=kv: train(kv) for kv in topo.workers])
+
+
+def test_stress_push_pull_multi_server_parties():
+    """Combined push_pull under the freshness-race stress configuration
+    (2-server parties, sharded keys, many rounds): exact every round."""
+    topo = Topology(servers_per_party=2, bigarray_bound=16).start(
+        sync_global=True)
+    try:
+        keys = [0, 1, 2]
+        w0 = {0: np.arange(40, dtype=np.float32),
+              1: np.ones(8, np.float32) * 3,
+              2: np.linspace(-5, 5, 33).astype(np.float32)}
+        _stress_rounds_push_pull(topo, keys, w0, rounds=20, n_workers=4)
+    finally:
+        topo.stop()
+
+
+def test_stress_push_pull_under_drop_and_resend():
+    """Combined push_pull rounds under message loss + retransmit: a
+    dropped/duplicated combined message must neither double-count a
+    push nor lose its data-carrying ack (the client falls back to an
+    explicit pull only when a server acks without data)."""
+    topo = Topology(extra_cfg={"drop_rate": 0.05, "resend": True,
+                               "resend_timeout_ms": 200}).start(
+        sync_global=True)
+    try:
+        keys = [0, 1]
+        w0 = {0: np.arange(24, dtype=np.float32),
+              1: np.full(10, 2.0, np.float32)}
+        _stress_rounds_push_pull(topo, keys, w0, rounds=8, n_workers=4)
+    finally:
+        topo.stop()
