@@ -69,10 +69,57 @@ def run(shapes, batched: bool, rounds: int) -> float:
     return max(times.values())
 
 
+def run_sparse(shapes, threshold: float, rounds: int) -> float:
+    """Protocol-only round time of the HEADLINE sparse path: the
+    combined element-sparse BSC wire (push_pull_bsc_batch — what the
+    device-resident trainer sends per round), aggregator-mode PS, top-k
+    payloads of ceil(size*threshold) per key."""
+    from geomx_tpu.simulate import InProcessHiPS
+
+    keys = list(range(len(shapes)))
+    topo = InProcessHiPS(num_parties=2, workers_per_party=1).start()
+    times = {}
+    try:
+        def master_init(kv):
+            for k, sh in zip(keys, shapes):
+                kv.init(k, np.zeros(sh, np.float32))
+            kv.wait()
+
+        def worker(kv):
+            rng = np.random.RandomState(3)
+            sel = []
+            for sh in shapes:
+                n = int(np.prod(sh))
+                k = max(int(n * threshold), 1)
+                idx = np.sort(rng.choice(n, size=k, replace=False))
+                sel.append((rng.rand(k).astype(np.float32), idx))
+            for k, sh in zip(keys, shapes):
+                kv.init(k, np.zeros(sh, np.float32))
+            kv.wait()
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                join = kv.push_pull_bsc_batch(
+                    keys, [v for v, _ in sel], [i for _, i in sel])
+                agg = join()
+                assert len(agg) == len(keys)
+            times[id(kv)] = (time.perf_counter() - t0) / rounds * 1e3
+
+        topo.run_workers(worker, include_master=master_init, timeout=600)
+    finally:
+        topo.stop()
+    return max(times.values())
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--layout", choices=sorted(LAYOUTS), default="cnn")
     ap.add_argument("--rounds", type=int, default=100)
+    ap.add_argument("--sparse", action="store_true",
+                    help="measure the combined element-sparse BSC wire "
+                         "(the device-resident trainer's round) instead "
+                         "of the dense push/pull wire")
+    ap.add_argument("--threshold", type=float, default=0.01,
+                    help="--sparse: top-k fraction per key")
     args = ap.parse_args()
 
     shapes = LAYOUTS[args.layout]
@@ -80,6 +127,13 @@ def main():
         rng = np.random.RandomState(0)
         shapes = [(int(s),)
                   for s in rng.choice([64, 512, 2048, 8192], 75)]
+    if args.sparse:
+        ms = run_sparse(shapes, args.threshold, args.rounds)
+        print(json.dumps({
+            "layout": args.layout, "keys": len(shapes), "sparse": True,
+            "threshold": args.threshold,
+            "bsc_push_pull_ms_per_round": round(ms, 2)}))
+        return
     per_key = run(shapes, batched=False, rounds=args.rounds)
     batched = run(shapes, batched=True, rounds=args.rounds)
     print(json.dumps({
