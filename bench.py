@@ -804,13 +804,17 @@ PHASES = {
     "hips": (bench_hips, 900, False),
     "hips_bsc": (bench_hips_bsc, 900, False),
     "hips_hfa": (bench_hips_hfa, 600, False),
-    "transformer_bsc": (bench_transformer_bsc, 2400, True),
+    # MFU rows precede transformer_bsc: they are ~3-5 min each on a
+    # healthy tunnel, while the 59M two-worker bootstrap can eat 10-20
+    # min — under the driver's overall budget the cheap rows must not
+    # be starved by the expensive one
     "transformer": (_mfu("transformer"), 1200, True),
     "transformer_flash": (_mfu("transformer_flash"), 1200, True),
     "transformer_long_dense": (_mfu("transformer_long_dense"), 1200,
                                True),
     "transformer_long_flash": (_mfu("transformer_long_flash"), 1200,
                                True),
+    "transformer_bsc": (bench_transformer_bsc, 2400, True),
 }
 DEFAULT_PARTIAL = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                ".bench_partial.json")
