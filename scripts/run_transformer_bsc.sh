@@ -8,5 +8,10 @@
 #   bash scripts/run_transformer_bsc.sh --cpu --dim 64 --depth 2 \
 #        --heads 4 --vocab 256 --seq-len 64 --max-iters 10
 cd "$(dirname "$0")"
+# a 59M bootstrap costs minutes per worker on a slow accelerator link
+# (236 MB device transfer + cold jit compiles) — the finished parties
+# must out-wait it at the barriers (env-tunable; config.py)
+export PS_BARRIER_TIMEOUT=${PS_BARRIER_TIMEOUT:-1800}
+export PS_OP_TIMEOUT=${PS_OP_TIMEOUT:-900}
 source ./hips_env.sh
 launch_hips "$REPO_DIR/examples/transformer_bsc_device.py" "$@"
