@@ -274,3 +274,69 @@ def test_worker_recovery_with_batched_wire():
             t.join(30)
         if topo.errors:
             raise topo.errors[0]
+
+
+def test_worker_recovery_with_push_pull_wire():
+    """The COMBINED push_pull wire across a worker death/recovery: the
+    survivor's combined round defers its data-carrying ack on the
+    missing peer; the revived worker joins the same round; values stay
+    exact (the merged ack carrying post-round params must survive the
+    re-registration)."""
+    topo = SingleTier().start()
+    KEYS = [0, 1]
+    W0 = {0: np.full(12, 10.0, np.float32),
+          1: np.full(5, -3.0, np.float32)}
+    try:
+        rank0 = next(kv for kv in topo.workers if kv.rank == 0)
+        victim = next(kv for kv in topo.workers if kv.rank == 1)
+        rank0.set_optimizer(SGD(learning_rate=1.0))
+        _parallel([lambda kv=kv: [kv.init(k, W0[k]) for k in KEYS]
+                   for kv in topo.workers])
+
+        def combined_round(kv, r):
+            outs = [np.zeros_like(W0[k]) for k in KEYS]
+            kv.push_pull(KEYS, [np.ones_like(W0[k]) for k in KEYS],
+                         out=outs)
+            kv.wait()
+            for k, o in zip(KEYS, outs):
+                np.testing.assert_allclose(o, W0[k] - 2.0 * r)
+
+        _parallel([lambda kv=kv: combined_round(kv, 1)
+                   for kv in topo.workers])
+
+        dead_id = victim.po.my_id
+        victim._closed = True
+        victim.po.van.stop()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if dead_id in topo.sched_po.van.dead_nodes():
+                break
+            time.sleep(0.1)
+        assert dead_id in topo.sched_po.van.dead_nodes()
+
+        results = []
+
+        def survivor():
+            combined_round(rank0, 2)
+            results.append("survivor")
+
+        t = threading.Thread(target=survivor, daemon=True)
+        t.start()
+
+        revived = KVStoreDist(cfg=topo._cfg(role="worker"))
+        assert revived.po.van.is_recovery
+        for k in KEYS:
+            revived.init(k, W0[k])
+        combined_round(revived, 2)
+        t.join(60)
+        assert results == ["survivor"], "survivor did not complete"
+
+        _parallel([lambda kv=kv: combined_round(kv, 3)
+                   for kv in (rank0, revived)])
+        topo.workers = [rank0, revived]
+    finally:
+        _parallel([kv.close for kv in topo.workers])
+        for t in topo.threads:
+            t.join(30)
+        if topo.errors:
+            raise topo.errors[0]
