@@ -21,3 +21,5 @@ from geomx_tpu.parallel.train_step import (  # noqa: F401
 from geomx_tpu.parallel.ring_attention import make_ring_attention  # noqa: F401
 from geomx_tpu.parallel.grad_accum import accumulate_gradients  # noqa: F401
 from geomx_tpu.parallel.pipeline import make_pipeline_fn  # noqa: F401
+from geomx_tpu.parallel.fsdp import (  # noqa: F401
+    FSDPTrainer, fsdp_shardings, fsdp_spec)

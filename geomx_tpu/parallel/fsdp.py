@@ -1,0 +1,130 @@
+"""FSDP / ZeRO-style parameter+optimizer sharding over the mesh.
+
+Beyond the reference (its only distributed axis is PS data parallelism):
+fully-sharded data parallelism the XLA-native way. There is no
+hand-written gather/scatter schedule — parameters, gradients, and
+optimizer state carry NamedShardings that split each leaf along its
+largest mesh-divisible axis over "dp", and GSPMD inserts the
+all-gather-on-use / reduce-scatter-on-grad collectives inside the one
+jitted train step (the scaling-book recipe: pick a mesh, annotate,
+let the compiler place collectives).
+
+What this buys: per-device memory for params + Adam state drops by
+~|dp| (ZeRO-3 equivalent), while the batch still splits over "dp".
+Composes with the existing axes — a leaf that can't split over "dp"
+(no axis divisible) stays replicated, exactly how GSPMD treats it.
+
+Usage:
+    mesh = make_mesh(tp=1)                       # dp = n_devices
+    tr = FSDPTrainer(model, optax.adamw(3e-4), mesh, example_input)
+    loss = tr.step(X, y)                         # X, y host arrays
+
+Verification: tests/test_parallel.py asserts (a) each param leaf's
+per-device shard is ~1/|dp| of the leaf, (b) the loss curve matches the
+replicated DataParallelTrainer bit-for-bit-close on the same data, and
+(c) the multichip dryrun compiles+runs the step on the virtual mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["fsdp_spec", "fsdp_shardings", "FSDPTrainer"]
+
+
+def fsdp_spec(shape, mesh: Mesh, axis: str = "dp") -> P:
+    """PartitionSpec splitting the LARGEST axis divisible by mesh[axis];
+    fully replicated when nothing divides (GSPMD semantics for scalars,
+    biases, and tiny leaves)."""
+    n = mesh.shape[axis]
+    if n == 1 or not shape:
+        return P()
+    best = -1
+    best_dim = -1
+    for d, s in enumerate(shape):
+        if s % n == 0 and s > best_dim:
+            best, best_dim = d, s
+    if best < 0:
+        return P()
+    parts: list = [None] * len(shape)
+    parts[best] = axis
+    return P(*parts)
+
+
+def fsdp_shardings(tree, mesh: Mesh, axis: str = "dp"):
+    """NamedSharding pytree for ``tree`` under the FSDP rule."""
+    return jax.tree_util.tree_map(
+        lambda leaf: NamedSharding(
+            mesh, fsdp_spec(getattr(leaf, "shape", ()), mesh, axis)),
+        tree)
+
+
+class FSDPTrainer:
+    """Fully-sharded DP train loop: params, grads, and optimizer state
+    sharded over "dp"; batch sharded over "dp"; one jitted step with
+    compiler-placed all-gather / reduce-scatter."""
+
+    def __init__(self, model, optimizer: optax.GradientTransformation,
+                 mesh: Mesh, example_input: jnp.ndarray,
+                 num_classes: int = 10, rng_seed: int = 42,
+                 loss_fn: Optional[Callable] = None):
+        self.model = model
+        self.mesh = mesh
+        params = model.init(jax.random.PRNGKey(rng_seed), example_input)
+        self.param_shardings = fsdp_shardings(params, mesh)
+        self.params = jax.device_put(params, self.param_shardings)
+        opt_state = optimizer.init(params)
+        # optimizer-state leaves mirror param shapes (Adam m/v) or are
+        # scalars (step counts) — the same rule shards both correctly
+        self.opt_shardings = fsdp_shardings(opt_state, mesh)
+        self.opt_state = jax.device_put(opt_state, self.opt_shardings)
+        self.batch_shard = NamedSharding(mesh, P("dp"))
+        self.num_classes = num_classes
+
+        if loss_fn is None:
+            def loss_fn(p, X, y):  # noqa: ANN001
+                logits = model.apply(p, X)
+                one_hot = jax.nn.one_hot(y, num_classes)
+                return -jnp.mean(
+                    jnp.sum(jax.nn.log_softmax(logits) * one_hot,
+                            axis=-1))
+
+        @jax.jit
+        def train_step(p, opt_state, X, y):
+            loss, grads = jax.value_and_grad(loss_fn)(p, X, y)
+            updates, opt_state = optimizer.update(grads, opt_state, p)
+            return optax.apply_updates(p, updates), opt_state, loss
+
+        # out_shardings pin the updated params/state back to their
+        # shards so the weight update runs shard-local (ZeRO-3): without
+        # them XLA could legally materialize replicated outputs
+        self._train_step = jax.jit(
+            train_step,
+            out_shardings=(self.param_shardings, self.opt_shardings,
+                           NamedSharding(mesh, P())))
+
+    def shard_batch(self, X, y):
+        return (jax.device_put(jnp.asarray(X), self.batch_shard),
+                jax.device_put(jnp.asarray(y), self.batch_shard))
+
+    def step(self, X, y) -> float:
+        X, y = self.shard_batch(X, y)
+        self.params, self.opt_state, loss = self._train_step(
+            self.params, self.opt_state, X, y)
+        return float(loss)
+
+    def param_shard_fraction(self) -> float:
+        """Mean over leaves of (per-device shard elems / leaf elems) —
+        ~1/|dp| when sharding engaged (memory win evidence)."""
+        fracs = []
+        for leaf in jax.tree_util.tree_leaves(self.params):
+            db = leaf.sharding.shard_shape(leaf.shape)
+            fracs.append(
+                float(jnp.prod(jnp.array(db)))
+                / max(float(jnp.prod(jnp.array(leaf.shape))), 1.0))
+        return sum(fracs) / len(fracs)

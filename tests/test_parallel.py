@@ -170,3 +170,43 @@ def test_hierarchical_trainer_geo_dp(devices):
         assert all(np.isfinite(losses0))
     finally:
         topo.stop()
+
+
+def test_fsdp_trainer_shards_and_matches_replicated(devices):
+    """FSDP (ZeRO-style) sharding: params/opt-state split ~1/dp per
+    device, and the loss trajectory matches the replicated DP trainer
+    on identical data (GSPMD collectives are exact, not approximate)."""
+    from geomx_tpu.parallel.fsdp import FSDPTrainer
+
+    mesh = make_mesh(devices)  # dp=8
+    model = create_cnn()
+    ex = jnp.zeros((1, 28, 28, 1), jnp.float32)
+    fsdp = FSDPTrainer(model, optax.adam(3e-3), mesh, ex)
+    repl = DataParallelTrainer(model, optax.adam(3e-3), mesh, ex)
+    # memory evidence: the big leaves are split (mean shard fraction
+    # well under 1; conv kernels whose axes don't divide stay whole)
+    assert fsdp.param_shard_fraction() < 0.6
+    from geomx_tpu.io import load_data
+    train_iter, _, _, _ = load_data(64, num_workers=1)
+    l_f, l_r = [], []
+    for i, (X, y) in enumerate(train_iter):
+        l_f.append(fsdp.step(X, y))
+        l_r.append(repl.step(X, y))
+        if i >= 10:
+            break
+    np.testing.assert_allclose(l_f, l_r, rtol=2e-4, atol=2e-4)
+    assert l_f[-1] < l_f[0]
+
+
+def test_fsdp_spec_rules(devices):
+    from jax.sharding import PartitionSpec as P
+
+    from geomx_tpu.parallel.fsdp import fsdp_spec
+
+    mesh = make_mesh(devices)  # dp=8
+    assert fsdp_spec((16, 3), mesh) == P("dp", None)
+    assert fsdp_spec((3, 24), mesh) == P(None, "dp")
+    assert fsdp_spec((5, 3), mesh) == P()     # nothing divides -> whole
+    assert fsdp_spec((), mesh) == P()         # scalar
+    # largest divisible axis wins
+    assert fsdp_spec((8, 800), mesh) == P(None, "dp")
