@@ -22,16 +22,33 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def _time(fn, *args, iters=20):
+def _time(fn, q, k, v, iters=20):
+    """Value-fenced timing (round-5: block_until_ready on the axon
+    tunnel platform returns WITHOUT waiting — tools/chip_sanity.py
+    blocking probe — which is how r4 published impossible numbers).
+    Iterations thread the output back into q so the dispatched chain is
+    data-dependent end to end, and the clock stops on a SCALAR fetch of
+    the last output; the fetch round-trip is measured separately and
+    subtracted."""
     import jax
+    import jax.numpy as jnp
 
-    out = fn(*args)
-    jax.block_until_ready(out)
+    def _head(out):
+        return out[0] if isinstance(out, tuple) else out
+
+    def _fence(x):
+        return float(jnp.sum(x.astype(jnp.float32)))
+
+    x = _head(fn(q, k, v))
+    _fence(x)                                   # warm compile + fence
+    t0 = time.perf_counter()
+    _fence(x)                                   # already computed:
+    rtt = time.perf_counter() - t0              # pure fetch round-trip
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters * 1e3
+        x = _head(fn(x, k, v))
+    _fence(x)
+    return max(time.perf_counter() - t0 - rtt, 1e-9) / iters * 1e3
 
 
 def main():
@@ -49,6 +66,11 @@ def main():
     args = ap.parse_args()
 
     import jax
+
+    if args.force_cpu:
+        # the axon plugin ignores JAX_PLATFORMS; pin before any device
+        # query (a dead tunnel otherwise hangs backend init)
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from geomx_tpu.models.transformer import dense_attention
