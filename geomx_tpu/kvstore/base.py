@@ -96,6 +96,13 @@ class KVStore:
     def pull(self, key, out=None, priority: int = 0):
         raise NotImplementedError
 
+    def push_pull(self, key, value, out, priority: int = 0) -> None:
+        """Combined push+pull (reference: ZPushPull, kv_app.h:140).
+        The base behavior is the two-op sequence; KVStoreDist overrides
+        it with the one-message-per-server combined wire."""
+        self.push(key, value, priority=priority)
+        self.pull(key, out=out, priority=priority)
+
     def wait(self, keys=None) -> None:
         """Block until outstanding ops on ``keys`` (or all) complete."""
 

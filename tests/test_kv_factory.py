@@ -52,3 +52,23 @@ def test_timeout_env_knobs(monkeypatch):
     cfg = cfg_mod.load()
     assert cfg.barrier_timeout_s == 1800.0
     assert cfg.op_timeout_s == 45.5
+
+
+def test_push_pull_on_local_store():
+    """The combined op exists on every store type (reference: ZPushPull
+    on all stores); local = the two-op sequence."""
+    import numpy as np
+
+    from geomx_tpu.kvstore import create
+    from geomx_tpu.optimizer import SGD
+
+    kv = create("local")
+    kv.set_optimizer(SGD(learning_rate=1.0))
+    kv.init(0, np.full(4, 5.0, np.float32))
+    kv.init(1, np.full(2, 1.0, np.float32))
+    outs = [np.zeros(4, np.float32), np.zeros(2, np.float32)]
+    kv.push_pull([0, 1], [np.ones(4, np.float32),
+                          np.ones(2, np.float32)], out=outs)
+    kv.wait()
+    np.testing.assert_allclose(outs[0], np.full(4, 4.0))
+    np.testing.assert_allclose(outs[1], np.full(2, 0.0))
