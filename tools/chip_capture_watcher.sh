@@ -48,13 +48,13 @@ import sys; sys.exit(0 if 'tpu' in jax.devices()[0].device_kind.lower() else 1)"
     if [ "$bench_rc" -ne 0 ] || [ ! -s CHIP_CAPTURE_ATTENTION.jsonl ]; then
       echo "$(date -Is) capture incomplete; resuming watch" \
           >> /tmp/chip_watch.log
-      sleep 600
+      sleep 300
       continue
     fi
     echo "$(date -Is) capture complete" >> /tmp/chip_watch.log
     exit 0
   fi
-  sleep 600
+  sleep 300
 done
 echo "$(date -Is) watcher deadline passed, tunnel never recovered" \
     >> /tmp/chip_watch.log
