@@ -15,6 +15,14 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
 x=jax.device_put(np.ones(8,'f4')); jax.block_until_ready(x); \
 import sys; sys.exit(0 if 'tpu' in jax.devices()[0].device_kind.lower() else 1)" \
       > /dev/null 2>&1; then
+    if pgrep -f "python.*bench\.py" > /dev/null 2>&1; then
+      # never contend with another bench on the one chip (e.g. the
+      # driver's round-end capture) — its numbers take precedence
+      echo "$(date -Is) another bench.py is running; standing down" \
+          >> /tmp/chip_watch.log
+      sleep 300
+      continue
+    fi
     echo "$(date -Is) tunnel healthy — capturing" >> /tmp/chip_watch.log
     timeout 3600 python bench.py --resume --partial "$PARTIAL" \
         --budget 3300 > CHIP_CAPTURE_BENCH.json.tmp 2>> /tmp/chip_watch.log
