@@ -1,0 +1,20 @@
+"""tools/chip_sanity.py probes on the CPU backend: correctness verdict
+must hold and the CPU clock must be honest (these same probes diagnosed
+the round-4 chip failures — denormal-flushed indices, dishonest
+block_until_ready)."""
+
+import pytest
+
+
+@pytest.mark.slow
+def test_chip_sanity_green_on_cpu():
+    from tools.chip_sanity import run_chip_sanity
+
+    out = run_chip_sanity(rounds=10)
+    assert out["transfer_bitexact"]["ok"], out
+    assert out["bitcast_in_jit"]["ok"], out
+    assert out["bsc_oracle"]["ok"], out
+    assert out["bsc_oracle"]["max_param_drift"] < 1e-3
+    assert out["ok"] is True
+    # CPU backends block honestly; the fence-required flag must be off
+    assert out["timing_fence_required"] is False, out["blocking_honest"]
