@@ -134,3 +134,63 @@ def test_push_bsc_duplicate_indices_sum():
         np.array([5, 5, 0], np.int32), 8)
     np.testing.assert_allclose(out[[0, 5]], [5.0, 3.0])
     assert out.sum() == 8.0
+
+
+@pytest.mark.parametrize("sharded", [False, True])
+def test_push_pull_bsc_batch_matches_two_op(sharded):
+    """The COMBINED sparse round must aggregate exactly like
+    push_bsc_batch + pull_bsc_batch — including keys partitioned
+    across server shards (per-rank slices of one batch, multi-rank
+    ack/data accounting)."""
+    n0, n1 = 40, 24
+    kw = dict(num_parties=2, workers_per_party=1)
+    if sharded:
+        kw.update(servers_per_party=2, bigarray_bound=16)
+    topo = InProcessHiPS(**kw).start()
+    results = {}
+    try:
+        def master_init(kv):
+            kv.init(0, np.zeros(n0, np.float32))
+            kv.init(1, np.zeros(n1, np.float32))
+            kv.wait()
+
+        def worker(kv):
+            widx = 0 if kv is topo.workers[0] else 1
+            for k, n in ((0, n0), (1, n1)):
+                kv.init(k, np.zeros(n, np.float32))
+                kv.pull(k, out=np.zeros(n, np.float32))
+            kv.wait()
+            if widx == 0:
+                sels = {0: (np.array([1.0, 2.0], np.float32),
+                            np.array([0, 33], np.int64)),
+                        1: (np.array([5.0], np.float32),
+                            np.array([17], np.int64))}
+            else:
+                sels = {0: (np.array([10.0, 20.0], np.float32),
+                            np.array([33, 39], np.int64)),
+                        1: (np.array([7.0, 8.0], np.float32),
+                            np.array([17, 3], np.int64))}
+            agg = kv.push_pull_bsc_batch(
+                [0, 1], [sels[0][0], sels[1][0]],
+                [sels[0][1], sels[1][1]])()
+            dense = {}
+            for k, n in ((0, n0), (1, n1)):
+                d = np.zeros(n, np.float32)
+                avals, aidx = agg[k]
+                d[aidx] = avals
+                dense[k] = d
+            results[widx] = dense
+
+        _run_workers(topo, worker, master_init)
+    finally:
+        topo.stop()
+
+    e0 = np.zeros(n0, np.float32)
+    e0[[0, 33]] += [1.0, 2.0]
+    e0[[33, 39]] += [10.0, 20.0]
+    e1 = np.zeros(n1, np.float32)
+    e1[[17]] += [5.0]
+    e1[[17, 3]] += [7.0, 8.0]
+    for w in (0, 1):
+        np.testing.assert_allclose(results[w][0], e0)
+        np.testing.assert_allclose(results[w][1], e1)
