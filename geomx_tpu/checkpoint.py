@@ -24,6 +24,7 @@ from flax import serialization
 __all__ = [
     "save_checkpoint", "load_checkpoint", "latest_checkpoint",
     "save_optimizer_states", "load_optimizer_states",
+    "serialize_blob", "deserialize_blob",
 ]
 
 
@@ -133,6 +134,17 @@ def serialize_states(states: Dict) -> bytes:
 def deserialize_states(data: bytes) -> Dict:
     pairs = _writable(serialization.msgpack_restore(data))
     return {_decode_key(k): v for k, v in pairs}
+
+
+def serialize_blob(doc: Dict) -> bytes:
+    """A small str-keyed document (which may nest bytes produced by
+    :func:`serialize_states`) to msgpack bytes — the container format of
+    server state snapshots (kvstore/replication.py)."""
+    return serialization.msgpack_serialize(_delist_tuples(doc))
+
+
+def deserialize_blob(data: bytes) -> Dict:
+    return _writable(serialization.msgpack_restore(data))
 
 
 def save_optimizer_states(fname: str, optimizer) -> None:

@@ -161,6 +161,24 @@ class Config:
     heartbeat_interval_s: int = 0       # PS_HEARTBEAT_INTERVAL (0 = off)
     heartbeat_timeout_s: int = 60       # PS_HEARTBEAT_TIMEOUT
     drop_rate: float = 0.0              # PS_DROP_MSG (fault injection)
+    # ---- robustness knobs (ours; see docs/robustness.md) ----
+    # seed for EVERY transport RNG (drop injection, fault plans, resend
+    # jitter); -1 = unseeded (wall-clock entropy, the old behavior)
+    ps_seed: int = -1                   # PS_SEED
+    # chaos plan: inline JSON, or "@/path/to/plan.json"
+    fault_plan: str = ""                # PS_FAULT_PLAN
+    # overall per-request retransmit deadline (seconds); a request
+    # unACKed past this raises TimeoutError at the issuing customer.
+    # 0 = no deadline (retry-count cap only, the old behavior)
+    resend_deadline_s: float = 0.0      # PS_RESEND_DEADLINE
+    resend_backoff_max_s: float = 30.0  # PS_RESEND_BACKOFF_MAX (cap)
+    resend_jitter: float = 0.1          # PS_RESEND_JITTER (+- fraction)
+    # server state snapshots: directory ("" = off) + tick interval
+    snapshot_dir: str = ""              # PS_SNAPSHOT_DIR
+    snapshot_interval_s: float = 5.0    # PS_SNAPSHOT_INTERVAL
+    # multi-server tiers: replicate snapshot deltas to the next-rank
+    # peer so a dead server's replacement can restore without a disk
+    replicate: bool = True              # PS_REPLICATE
     verbose: int = 0                    # PS_VERBOSE
     # round-4 verdict item 2: the reference makes its transport deadlines
     # env-tunable (van.cc:527-533 PS_RESEND_TIMEOUT / heartbeat envs);
@@ -244,6 +262,14 @@ def load() -> Config:
         heartbeat_interval_s=env_int("PS_HEARTBEAT_INTERVAL", 0),
         heartbeat_timeout_s=env_int("PS_HEARTBEAT_TIMEOUT", 60),
         drop_rate=env_float("PS_DROP_MSG", 0.0),
+        ps_seed=env_int("PS_SEED", -1),
+        fault_plan=env_str("PS_FAULT_PLAN"),
+        resend_deadline_s=env_float("PS_RESEND_DEADLINE", 0.0),
+        resend_backoff_max_s=env_float("PS_RESEND_BACKOFF_MAX", 30.0),
+        resend_jitter=env_float("PS_RESEND_JITTER", 0.1),
+        snapshot_dir=env_str("PS_SNAPSHOT_DIR"),
+        snapshot_interval_s=env_float("PS_SNAPSHOT_INTERVAL", 5.0),
+        replicate=env_bool("PS_REPLICATE", True),
         verbose=env_int("PS_VERBOSE", 0),
         barrier_timeout_s=env_float("PS_BARRIER_TIMEOUT", 600.0),
         op_timeout_s=env_float("PS_OP_TIMEOUT", 300.0),

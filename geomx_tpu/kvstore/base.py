@@ -35,6 +35,9 @@ class Command:
     ESYNC_STATE = 11              # ESync state-server report -> step count
     #                               (beyond parity: reference README.md:45
     #                               documents ESync but ships no code)
+    REPLICA_UPDATE = 12           # server -> peer server: snapshot delta
+    #                               (durable recovery; docs/robustness.md)
+    REPLICA_FETCH = 13            # recovering server <- peer: full replica
 
 
 # Data-plane cmd values carried in push meta.head.

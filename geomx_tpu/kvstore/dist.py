@@ -46,6 +46,17 @@ from geomx_tpu.ps.postoffice import Postoffice
 log = logging.getLogger("geomx.dist")
 
 
+def _give_up_exc(errs) -> type:
+    """Exception class for surfacing transport give-ups: a blown
+    PS_RESEND_DEADLINE (the resender tags it "delivery deadline") is a
+    TimeoutError at the issuing customer; retry-cap give-ups stay
+    RuntimeError. Callback-driven ops only see the reason STRING
+    (Customer.on_fail), so the class is recovered from it here."""
+    return (TimeoutError
+            if any("delivery deadline" in e for e in errs)
+            else RuntimeError)
+
+
 class _KeyInfo:
     __slots__ = ("total", "shape", "dtype", "shards")
 
@@ -872,8 +883,8 @@ class KVStoreDist(KVStore):
                         e for e in self._transport_errors
                         if e not in fails]
             if errs:
-                raise RuntimeError("transport gave up on "
-                                   + "; ".join(errs))
+                raise _give_up_exc(errs)("transport gave up on "
+                                         + "; ".join(errs))
             with self._lock:
                 got = list(parts)
             if not got:
@@ -1017,8 +1028,8 @@ class KVStoreDist(KVStore):
                         e for e in self._transport_errors
                         if e not in fails]
             if errs:
-                raise RuntimeError("transport gave up on "
-                                   + "; ".join(errs))
+                raise _give_up_exc(errs)("transport gave up on "
+                                         + "; ".join(errs))
             out = {}
             with self._lock:
                 got = {k: list(v) for k, v in parts.items()}
@@ -1134,8 +1145,8 @@ class KVStoreDist(KVStore):
                         e for e in self._transport_errors
                         if e not in fails]
             if errs:
-                raise RuntimeError("transport gave up on "
-                                   + "; ".join(errs))
+                raise _give_up_exc(errs)("transport gave up on "
+                                         + "; ".join(errs))
             out = {}
             with self._lock:
                 got = {k: list(v) for k, v in parts.items()}
@@ -1175,7 +1186,7 @@ class KVStoreDist(KVStore):
         with self._lock:
             errs, self._transport_errors = self._transport_errors, []
         if errs:
-            raise RuntimeError("transport gave up on " + "; ".join(errs))
+            raise _give_up_exc(errs)("transport gave up on " + "; ".join(errs))
 
     waitall = wait
 

@@ -296,6 +296,13 @@ class KVStoreDistServer:
         # counting + uniformity validation (round-2 Weak #5)
         self._party_nsrv = 1
         self._party_nsrv_by_sender: Dict[int, int] = {}
+        # durable recovery: periodic snapshots + peer replicas; a
+        # FaultPlan-induced van crash sets _crashed so shutdown skips
+        # the exit barrier (survivors aren't waiting for a dead node)
+        from geomx_tpu.kvstore.replication import ReplicationManager
+
+        self.replication = ReplicationManager(self, c)
+        self._crashed = False
 
     # ------------------------------------------------------------------
     # lifecycle (reference: kvstore_dist.h:237-258 RunServer)
@@ -365,10 +372,24 @@ class KVStoreDistServer:
                     self.worker_global.set_request_handle(
                         lambda req, kvs, srv: self._handle(req, kvs, srv,
                                                            global_tier=True))
-        if self.po_global is not None:
-            # startup barrier, global tier (reference: kvstore_dist.h:249-251)
+        if self.po_global is not None and not self.po_global.van.is_recovery:
+            # startup barrier, global tier (reference: kvstore_dist.h:249-251);
+            # gated like the local one — a recovering server must not wait
+            # for a barrier round the survivors already passed
             self.po_global.barrier(psbase.ALL_GROUP,
                                    timeout=self.cfg.barrier_timeout_s)
+        # a FaultPlan crash primitive stops the van; propagate to the
+        # server loop so run() exits and shutdown skips dead barriers
+        self.po_local.van.on_crash = self._on_van_crash
+        if self.po_global is not None:
+            self.po_global.van.on_crash = self._on_van_crash
+        if (self.po_local.van.is_recovery
+                or (self.po_global is not None
+                    and self.po_global.van.is_recovery)):
+            # repopulate from snapshot/replica BEFORE serving any request:
+            # resumed training must observe pre-crash weights, not re-init
+            self.replication.restore()
+        self.replication.start()
         self._ready.set()
 
     def run(self) -> None:
@@ -379,11 +400,31 @@ class KVStoreDistServer:
         self.shutdown()
 
     def shutdown(self) -> None:
+        # clean exit flushes a final snapshot; after a crash the point is
+        # to test recovery from the last PERIODIC tick, and the vans are
+        # already dead, so skip both the flush and the exit barriers
+        self.replication.stop(flush=not self._crashed)
         try:
-            self.po_local.finalize(do_barrier=True)
+            self.po_local.finalize(do_barrier=not self._crashed)
         finally:
             if self.po_global is not None:
-                self.po_global.finalize(do_barrier=True)
+                self.po_global.finalize(do_barrier=not self._crashed)
+
+    def crash(self) -> None:
+        """Hard-kill this server as a fault would: stop both vans NOW, no
+        exit barriers, no final snapshot flush. Tests use this (directly
+        or via the FaultPlan crash primitive) to simulate a server death
+        that a replacement with ``is_recovery=True`` then recovers from."""
+        self._crashed = True
+        self._stop.set()
+        self.po_local.van.stop()
+        if self.po_global is not None:
+            self.po_global.van.stop()
+
+    def _on_van_crash(self) -> None:
+        # called by the van after a FaultPlan "crash" rule fired (the van
+        # itself is already stopped; crash() re-stopping it is a no-op)
+        self.crash()
 
     # ------------------------------------------------------------------
     # request entry (reference: DataHandleEx, kvstore_dist_server.h:432)
@@ -1468,6 +1509,16 @@ class KVStoreDistServer:
                     if self.is_global_server and self.po_global is not None
                     else self.po_local.my_rank)
             srv.response(req, body=json.dumps({str(rank): states_hex}))
+            return
+        if head == Command.REPLICA_UPDATE:
+            # a peer server's snapshot delta (kvstore/replication.py);
+            # accumulate it so we can serve that peer's replacement later
+            self.replication.accept_replica(body)
+            srv.response(req)
+            return
+        if head == Command.REPLICA_FETCH:
+            # a recovering peer asks for its full replica image
+            srv.response(req, body=self.replication.serve_replica(body))
             return
         if head == Command.SET_OPTIMIZER_STATES:
             if (self.has_global_tier and not global_tier

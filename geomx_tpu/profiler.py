@@ -138,6 +138,20 @@ def scope(name: str, cat: str = "geomx", **args):
         record(name, cat, start, _now_us() - start, args or None)
 
 
+def instant(name: str, cat: str = "geomx", **args: Any) -> None:
+    """Record an instant ('i') event — a point-in-time marker for things
+    with no duration: snapshot writes, recovery restores, injected
+    crashes. Process-scoped so it renders as a full-height line."""
+    if not is_running():
+        return
+    ev = {"name": name, "cat": cat, "ph": "i", "s": "p", "ts": _now_us(),
+          "pid": os.getpid(), "tid": threading.get_ident() % (1 << 31)}
+    if args:
+        ev["args"] = args
+    with _lock:
+        _events.append(ev)
+
+
 def counter(name: str, value: float, cat: str = "geomx") -> None:
     """Record an instant counter sample (bytes sent, queue depths...)."""
     if not is_running():

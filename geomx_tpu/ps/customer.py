@@ -32,10 +32,11 @@ class Customer:
         self._cv = threading.Condition(self._lock)
         # ts -> [num_expected, num_received]
         self._tracker: Dict[int, list] = {}
-        # ts -> failure reason; set by the transport when a request becomes
-        # undeliverable (resender give-up) so wait_request fails fast
-        # instead of blocking to its timeout
-        self._errors: Dict[int, str] = {}
+        # ts -> (failure reason, exception type); set by the transport
+        # when a request becomes undeliverable (resender give-up /
+        # delivery deadline) so wait_request fails fast — with the right
+        # exception class — instead of blocking to its timeout
+        self._errors: Dict[int, tuple] = {}
         # callback-driven requests are never wait()ed; auto-drop their
         # tracker entries on completion to avoid unbounded growth
         self._auto_clear: set = set()
@@ -75,7 +76,8 @@ class Customer:
             err = self._errors.pop(ts, None)
             entry = self._tracker.pop(ts, None)
             if err is not None and not (entry and entry[1] >= entry[0]):
-                raise RuntimeError(err)
+                reason, exc = err
+                raise exc(reason)
 
     def num_response(self, ts: int) -> int:
         with self._lock:
@@ -96,8 +98,13 @@ class Customer:
     # cb request has no wait() to surface the error through
     on_fail = None
 
-    def fail_request(self, ts: int, reason: str) -> None:
+    def fail_request(self, ts: int, reason: str,
+                     exc: type = RuntimeError) -> None:
         """Mark an in-flight request undeliverable (transport give-up).
+
+        ``exc`` is the exception class wait_request raises for it —
+        RuntimeError for a retry-cap give-up, TimeoutError for a blown
+        delivery deadline.
 
         Waited requests: the error is recorded and wait_request raises.
         Callback-driven (auto_clear) requests: the tracker entry is
@@ -113,7 +120,7 @@ class Customer:
                 self._auto_clear.discard(ts)
                 hook = self.on_fail
             else:
-                self._errors[ts] = reason
+                self._errors[ts] = (reason, exc)
                 self._cv.notify_all()
         if hook is not None:
             hook(ts, reason)

@@ -16,6 +16,7 @@ from typing import Dict, List, Optional, Tuple
 
 from geomx_tpu import config as cfg_mod
 from geomx_tpu.ps import base
+from geomx_tpu.ps import faults
 from geomx_tpu.ps.customer import Customer
 from geomx_tpu.ps.message import Message, Role
 from geomx_tpu.ps.van import Van
@@ -54,6 +55,12 @@ class Postoffice:
             drop_rate=cfg.drop_rate,
             resend_timeout_s=(cfg.resend_timeout_ms / 1000.0
                               if cfg.resend else 0.0),
+            resend_deadline_s=cfg.resend_deadline_s,
+            resend_backoff_max_s=cfg.resend_backoff_max_s,
+            resend_jitter=cfg.resend_jitter,
+            # PS_SEED / PS_FAULT_PLAN: deterministic fault injection
+            seed=faults.van_seed(cfg, my_role, is_global),
+            fault_plan=faults.plan_from_config(cfg),
             heartbeat_interval_s=cfg.heartbeat_interval_s,
             heartbeat_timeout_s=cfg.heartbeat_timeout_s,
             # the priority Sending thread runs in EVERY van (reference:
@@ -189,16 +196,22 @@ class Postoffice:
             return
         cust.accept(msg)
 
-    def _on_request_undeliverable(self, msg: Message) -> None:
-        """Resender gave up on one of OUR requests: fail the tracker entry
-        so wait() raises promptly instead of blocking to its timeout."""
+    def _on_request_undeliverable(self, msg: Message,
+                                  exc: type = RuntimeError,
+                                  reason: str = "") -> None:
+        """Resender gave up on one of OUR requests (retry cap, or the
+        delivery deadline — then ``exc`` is TimeoutError): fail the
+        tracker entry so wait() raises promptly, and with the right
+        exception class, instead of blocking to its timeout."""
         with self._customers_lock:
             cust = self._customers.get((msg.meta.app_id, msg.meta.customer_id))
         if cust is not None:
             cust.fail_request(
                 msg.meta.timestamp,
                 f"request ts={msg.meta.timestamp} to node {msg.meta.recver} "
-                f"undeliverable: retransmit retries exhausted")
+                f"undeliverable: "
+                + (reason or "retransmit retries exhausted"),
+                exc=exc)
 
     def attach_ts(self, node) -> None:
         """Register a member-side TSNode to receive REPLY control traffic."""

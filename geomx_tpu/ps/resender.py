@@ -19,7 +19,12 @@ Deltas from the reference, on purpose:
   peer cannot accumulate an unbounded resend queue — the reference leans
   on heartbeat-based dead-node eviction for that instead. On give-up the
   ``on_give_up`` hook fires and the van routes request failures back to
-  the issuing customer (wait() raises; callbacks get a failure flag).
+  the issuing customer (wait() raises; callbacks get a failure flag);
+- retransmit intervals back off exponentially from ``PS_RESEND_TIMEOUT``
+  (capped at ``PS_RESEND_BACKOFF_MAX``) with seedable +-jitter, instead
+  of the reference's fixed interval, and an optional overall delivery
+  deadline (``PS_RESEND_DEADLINE``) abandons a message with a clear
+  ``TimeoutError`` raised at the issuing customer's wait().
 
 Enabled via ``PS_RESEND=1`` (reference: van.cc:527-533). Pairs with the
 ``PS_DROP_MSG`` fault injection: a lossy van with resend enabled must
@@ -30,6 +35,7 @@ from __future__ import annotations
 
 import itertools
 import logging
+import random
 import threading
 import time
 from collections import OrderedDict, deque
@@ -48,13 +54,27 @@ _DEDUP_WINDOW = 100_000  # remembered accepted signatures
 class Resender:
     """Tracks in-flight messages for one van and re-sends unACKed ones."""
 
-    def __init__(self, van: "Van", timeout_s: float, max_retries: int = 10):
+    def __init__(self, van: "Van", timeout_s: float, max_retries: int = 10,
+                 deadline_s: float = 0.0, max_backoff_s: float = 30.0,
+                 jitter: float = 0.1, seed=None):
         self.van = van
         self.timeout_s = timeout_s
         self.max_retries = max_retries
+        # overall per-message delivery deadline: past it the message is
+        # abandoned with TimeoutError semantics (PS_RESEND_DEADLINE);
+        # 0 = retry-count cap only
+        self.deadline_s = deadline_s
+        # retransmit intervals back off exponentially (timeout_s * 2^n,
+        # capped at max_backoff_s) with +-jitter so a congested link
+        # isn't hammered at a fixed period and retransmit storms from
+        # many peers decorrelate; the jitter RNG is seeded (PS_SEED) so
+        # retry schedules reproduce
+        self.max_backoff_s = max_backoff_s
+        self.jitter = max(0.0, min(jitter, 0.99))
+        self._rng = random.Random(seed)
         self._lock = threading.Lock()
-        # sig -> (target, message, first_send_monotonic, num_resends)
-        self._outgoing: "OrderedDict[int, Tuple[int, Message, float, int]]" = (
+        # sig -> (target, message, first_send_monotonic, next_due, num_resends)
+        self._outgoing: "OrderedDict[int, Tuple[int, Message, float, float, int]]" = (
             OrderedDict())
         self._seen: Set[int] = set()
         self._seen_order: Deque[int] = deque()
@@ -72,11 +92,13 @@ class Resender:
         self._thread.start()
         self.num_resends = 0
         self.num_duplicates = 0
-        # invoked (outside the lock) with (target, msg) when a message
-        # exhausts max_retries — the van routes request give-ups back to
-        # the issuing customer so its wait() fails fast (the reference
-        # has no cap and leans on heartbeat eviction; with a cap, silence
-        # would leave the requester blocked to its timeout)
+        # invoked (outside the lock) with (target, msg, exc, reason)
+        # when a message exhausts max_retries (exc=RuntimeError) or its
+        # delivery deadline (exc=TimeoutError) — the van routes request
+        # give-ups back to the issuing customer so its wait() fails fast
+        # with the right exception type (the reference has no cap and
+        # leans on heartbeat eviction; with a cap, silence would leave
+        # the requester blocked to its timeout)
         self.on_give_up = None
 
     # -- sender side -----------------------------------------------------
@@ -88,10 +110,18 @@ class Resender:
         msg.meta.msg_sig = sig
         return sig
 
+    def _backoff(self, n: int) -> float:
+        """Interval before resend n+1: exponential with +-jitter."""
+        b = min(self.timeout_s * (2 ** n), self.max_backoff_s)
+        if self.jitter > 0:
+            b *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return b
+
     def add_outgoing(self, target: int, msg: Message) -> None:
+        now = time.monotonic()
         with self._lock:
-            self._outgoing[msg.meta.msg_sig] = (target, msg,
-                                                time.monotonic(), 0)
+            self._outgoing[msg.meta.msg_sig] = (
+                target, msg, now, now + self._backoff(0), 0)
 
     def handle_ack(self, sig: int) -> None:
         with self._lock:
@@ -143,21 +173,38 @@ class Resender:
             to_resend = []
             gave_up = []
             with self._lock:
-                for sig, (target, msg, t_sent, n) in list(self._outgoing.items()):
-                    if now - t_sent < self.timeout_s * (n + 1):
+                for sig, (target, msg, t0, due,
+                          n) in list(self._outgoing.items()):
+                    if self.deadline_s > 0 and now - t0 >= self.deadline_s:
+                        log.error("abandoning msg sig=%x to %d: no ACK "
+                                  "within the %.1fs delivery deadline "
+                                  "(%d resends)", sig, target,
+                                  self.deadline_s, n)
+                        self._outgoing.pop(sig, None)
+                        gave_up.append((
+                            target, msg, TimeoutError,
+                            f"no ACK from node {target} within the "
+                            f"{self.deadline_s:.1f}s delivery deadline "
+                            f"({n} retransmits)"))
+                        continue
+                    if now < due:
                         continue
                     if n >= self.max_retries:
                         log.error("giving up on msg sig=%x to %d after %d "
                                   "resends", sig, target, n)
                         self._outgoing.pop(sig, None)
-                        gave_up.append((target, msg))
+                        gave_up.append((
+                            target, msg, RuntimeError,
+                            f"retransmit retries exhausted to node "
+                            f"{target} ({n} resends)"))
                         continue
-                    self._outgoing[sig] = (target, msg, t_sent, n + 1)
+                    self._outgoing[sig] = (
+                        target, msg, t0, now + self._backoff(n + 1), n + 1)
                     to_resend.append((target, msg))
-            for target, msg in gave_up:
+            for target, msg, exc, reason in gave_up:
                 if self.on_give_up is not None:
                     try:
-                        self.on_give_up(target, msg)
+                        self.on_give_up(target, msg, exc, reason)
                     except Exception:  # noqa: BLE001 — monitor must survive
                         log.exception("on_give_up hook failed")
             for target, msg in to_resend:

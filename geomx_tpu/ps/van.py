@@ -35,6 +35,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from geomx_tpu import profiler
 from geomx_tpu.ps import base
 from geomx_tpu.ps import dgt as dgt_mod
+from geomx_tpu.ps import faults as faults_mod
 from geomx_tpu.ps import native as native_mod
 from geomx_tpu.ps import resender as resender_mod
 from geomx_tpu.ps.message import (Control, Message, Meta, Node, Role,
@@ -59,11 +60,16 @@ class Van:
         advertise_host: str = "",
         drop_rate: float = 0.0,
         resend_timeout_s: float = 0.0,
+        resend_deadline_s: float = 0.0,
+        resend_backoff_max_s: float = 30.0,
+        resend_jitter: float = 0.1,
         heartbeat_interval_s: float = 0.0,
         heartbeat_timeout_s: float = 60.0,
         use_priority_send: bool = False,
         verbose: int = 0,
         dgt: Optional[dict] = None,
+        seed: Optional[int] = None,
+        fault_plan: Optional["faults_mod.FaultPlan"] = None,
     ):
         self.my_role = my_role
         self.is_global = is_global
@@ -83,8 +89,27 @@ class Van:
                 "address (DMLC_NODE_HOST) — peers cannot dial 0.0.0.0")
         self.drop_rate = drop_rate
         self.resend_timeout_s = resend_timeout_s
+        self.resend_deadline_s = resend_deadline_s
+        self.resend_backoff_max_s = resend_backoff_max_s
+        self.resend_jitter = resend_jitter
         # ACK/retransmit layer (reference: resender.h, PS_RESEND)
         self._resender: Optional["resender_mod.Resender"] = None
+        # per-van RNG for legacy PS_DROP_MSG injection: seeded from
+        # PS_SEED (via faults.van_seed) so even the uniform drop is
+        # reproducible; None keeps wall-clock entropy
+        self.seed = seed
+        self._rng = random.Random(seed)
+        # declarative chaos (PS_FAULT_PLAN): consulted by every inbound
+        # dispatch before the legacy drop_rate check
+        self._faults = fault_plan.bind(self) if fault_plan is not None \
+            else None
+        # fired (after stop()) when a FaultPlan crash rule kills this
+        # van — the owner simulates full process death (e.g. a
+        # KVStoreDistServer also drops its other tier's van)
+        self.on_crash: Optional[Callable[[], None]] = None
+        # inbound non-control frames accepted through the gate; chaos
+        # tests use it to place crash points on exact message indices
+        self.num_data_recv = 0
         self.heartbeat_interval_s = heartbeat_interval_s
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.use_priority_send = use_priority_send
@@ -166,8 +191,14 @@ class Van:
     def start(self, timeout: float = 60.0) -> None:
         self._bind()
         if self.resend_timeout_s > 0:
-            self._resender = resender_mod.Resender(self, self.resend_timeout_s)
+            self._resender = resender_mod.Resender(
+                self, self.resend_timeout_s,
+                deadline_s=self.resend_deadline_s,
+                max_backoff_s=self.resend_backoff_max_s,
+                jitter=self.resend_jitter, seed=self.seed)
             self._resender.on_give_up = self._on_resend_give_up
+        if self._faults is not None:
+            self._faults.arm()
         if self._native is not None:
             self._spawn(self._native_recv_loop, "van-nrecv")
         else:
@@ -262,27 +293,56 @@ class Van:
             self.recv_bytes += len(buf)
             try:
                 msg = Message.unpack(buf)
-                if (
-                    self.drop_rate > 0
-                    and not msg.is_control
-                    and random.random() < self.drop_rate
-                ):
-                    if self.verbose:
-                        log.info("PS_DROP_MSG: dropping frame from %d",
-                                 msg.meta.sender)
+                if not self._inbound_gate(msg):
                     continue
                 self._process(msg)
             except Exception:
                 log.exception("error processing inbound frame; loop kept")
 
-    def _on_resend_give_up(self, target: int, msg: Message) -> None:
-        """A message exhausted its retransmit budget. For requests WE
-        issued, surface the failure to the issuing customer so its wait()
-        raises instead of blocking to its own timeout (round-2 advisor
-        finding: resender.py gave up with only log.error)."""
+    def _inbound_gate(self, msg: Message) -> bool:
+        """Every inbound frame passes here before dispatch: first the
+        FaultPlan (if any), then the legacy uniform PS_DROP_MSG check —
+        now drawn from the per-van seeded RNG instead of the process
+        global one, so drop schedules reproduce under PS_SEED."""
+        if self._faults is not None and not self._faults.on_inbound(msg):
+            return False
+        if (self.drop_rate > 0 and not msg.is_control
+                and self._rng.random() < self.drop_rate):
+            if self.verbose:
+                log.info("PS_DROP_MSG: dropping frame from %d",
+                         msg.meta.sender)
+            return False
+        if not msg.is_control:
+            self.num_data_recv += 1
+        return True
+
+    def _crash_from_fault(self, reason: str) -> None:
+        """A FaultPlan crash rule fired: hard-kill this van (no goodbye,
+        no barrier — indistinguishable from a process death to peers)
+        and tell the owner via on_crash."""
+        log.warning("%s crashing van: %s", self._tag(), reason)
+        profiler.instant("fault.crash", cat="fault",
+                         node=self.my_id, reason=reason)
+        cb = self.on_crash
+        self.stop()
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001
+                log.exception("on_crash hook failed")
+
+    def _on_resend_give_up(self, target: int, msg: Message,
+                           exc: type = RuntimeError,
+                           reason: str = "") -> None:
+        """A message exhausted its retransmit budget (``exc`` is
+        RuntimeError) or blew its overall delivery deadline (``exc`` is
+        TimeoutError). For requests WE issued, surface the failure to
+        the issuing customer so its wait() raises instead of blocking to
+        its own timeout (round-2 advisor finding: resender.py gave up
+        with only log.error)."""
         if msg.meta.request and msg.meta.timestamp >= 0 \
                 and self.give_up_handler is not None:
-            self.give_up_handler(msg)
+            self.give_up_handler(msg, exc, reason)
 
     def _start_dgt(self) -> None:
         """Bind UDP channels + spawn schedulers (reference: van.cc:613-646)."""
@@ -329,7 +389,7 @@ class Van:
             self.recv_bytes += len(data)
             try:
                 msg = Message.unpack(data)
-                if self.drop_rate > 0 and random.random() < self.drop_rate:
+                if not self._inbound_gate(msg):
                     continue
                 self._process(msg)
             except Exception:
@@ -454,6 +514,10 @@ class Van:
         return self._send_one_inner(target, msg)
 
     def _send_one_inner(self, target: int, msg: Message) -> int:
+        # send-side crash counting ("crash ... on: send" rules): the van
+        # dies BEFORE this frame reaches the wire
+        if self._faults is not None and not self._faults.on_send(target, msg):
+            return 0
         # register for retransmission before the wire attempt so even a
         # failed first send is retried by the monitor (reference:
         # resender.h:36 AddOutgoing). sig==0 means not-yet-registered;
@@ -563,13 +627,7 @@ class Van:
             msg, nbytes = got
             self.recv_bytes += nbytes
             try:
-                if (
-                    self.drop_rate > 0
-                    and not msg.is_control
-                    and random.random() < self.drop_rate
-                ):
-                    if self.verbose:
-                        log.info("PS_DROP_MSG: dropping frame from %d", msg.meta.sender)
+                if not self._inbound_gate(msg):
                     continue
                 self._process(msg)
             except Exception:

@@ -1,0 +1,57 @@
+#!/bin/bash
+# Chaos matrix: the vanilla-HiPS demo (12 processes, 3 parties) run
+# under three representative seeded fault plans. Every random decision
+# is drawn from PS_SEED-derived streams (geomx_tpu/ps/faults.py), so a
+# failing case reproduces exactly by re-running with the same seed.
+# The resender is always on: the point of each case is that training
+# still completes despite the injected faults.
+#
+# Cases:
+#   loss       20% data-frame drop on every link
+#   wan-jitter added latency + jitter on half the frames, 5% duplicates
+#   partition  server id 8 cut off from everyone for 3s mid-run
+#
+# Usage: ./run_chaos_matrix.sh [extra worker args...]
+#   PS_SEED=<n> picks the schedule (default 7).
+cd "$(dirname "$0")"
+SEED=${PS_SEED:-7}
+FAILED=0
+
+run_case() {
+  local name="$1" plan="$2" port_base="$3"; shift 3
+  echo "=== chaos[$name] seed=$SEED ==="
+  (
+    export PS_SEED=$SEED
+    export PS_FAULT_PLAN="$plan"
+    # retransmit layer: short timeout so drops heal fast, an overall
+    # delivery deadline so a wedged run fails loudly instead of hanging
+    export PS_RESEND=1 PS_RESEND_TIMEOUT=500 PS_RESEND_DEADLINE=120
+    # distinct ports per case: no TIME_WAIT clashes between cases
+    export GPORT=$port_base CPORT=$((port_base + 1)) \
+           APORT=$((port_base + 2)) BPORT=$((port_base + 3))
+    source ./hips_env.sh
+    launch_hips "$REPO_DIR/examples/cnn.py" --cpu "$@"
+    wait
+  )
+  if [ $? -eq 0 ]; then
+    echo "=== chaos[$name] OK ==="
+  else
+    echo "=== chaos[$name] FAILED (re-run with PS_SEED=$SEED to reproduce) ==="
+    FAILED=1
+  fi
+}
+
+run_case loss \
+  '[{"type": "drop", "p": 0.2}]' \
+  9490 "$@"
+
+run_case wan-jitter \
+  '[{"type": "delay", "delay_s": 0.02, "jitter_s": 0.03, "p": 0.5},
+    {"type": "dup", "p": 0.05}]' \
+  9590 "$@"
+
+run_case partition \
+  '[{"type": "partition", "between": [8, "*"], "start_s": 5.0, "duration_s": 3.0}]' \
+  9690 "$@"
+
+exit $FAILED

@@ -13,6 +13,7 @@ values on resumed training. Single-tier PS topology (the reference's
 global-tier recovery is explicitly unimplemented: van.cc:224 TODO).
 """
 
+import json
 import threading
 import time
 
@@ -33,14 +34,21 @@ HB = {"heartbeat_interval_s": 0.2, "heartbeat_timeout_s": 1.0}
 
 
 class SingleTier:
-    """scheduler + 1 server + 2 workers with fast heartbeats."""
+    """scheduler + N servers + 2 workers with fast heartbeats.
 
-    def __init__(self):
+    ``extra`` merges into every node's Config (snapshot dirs, fault
+    plans, resend knobs...) so robustness tests configure the whole tier
+    the way a launch script would via environment variables."""
+
+    def __init__(self, extra=None, num_servers=1):
         self.port = free_port()
+        self.extra = dict(extra or {})
+        self.num_servers = num_servers
         self.threads = []
         self.errors = []
         self.sched_po = None
         self.server = None
+        self.servers = []
         self.workers = []
 
     def _run(self, fn):
@@ -56,15 +64,19 @@ class SingleTier:
 
     def _cfg(self, **kw):
         base = dict(ps_root_uri="127.0.0.1", ps_root_port=self.port,
-                    num_workers=2, num_servers=1, **HB)
+                    num_workers=2, num_servers=self.num_servers, **HB)
+        base.update(self.extra)
         base.update(kw)
         return Config(**base)
 
     def start(self):
+        sched_cfg = dict(HB)
+        sched_cfg.update(self.extra)
         self.sched_po = Postoffice(
             my_role=Role.SCHEDULER, is_global=False,
             root_uri="127.0.0.1", root_port=self.port,
-            num_workers=2, num_servers=1, cfg=Config(**HB))
+            num_workers=2, num_servers=self.num_servers,
+            cfg=Config(**sched_cfg))
 
         def sched():
             self.sched_po.start(60)
@@ -73,8 +85,11 @@ class SingleTier:
             self.sched_po.van.stop()
 
         self._run(sched)
-        self.server = KVStoreDistServer(self._cfg(role="server"))
-        self._run(self.server.run)
+        self.servers = [KVStoreDistServer(self._cfg(role="server"))
+                        for _ in range(self.num_servers)]
+        self.server = self.servers[0]
+        for s in self.servers:
+            self._run(s.run)
         boxes = [[], []]
         for i in range(2):
             self._run(lambda b=boxes[i]: b.append(
@@ -340,3 +355,293 @@ def test_worker_recovery_with_push_pull_wire():
             t.join(30)
         if topo.errors:
             raise topo.errors[0]
+
+
+# ----------------------------------------------------------------------
+# durable recovery (kvstore/replication.py): a revived server serves
+# PRE-CRASH values — beyond the reference, whose store is volatile
+# ----------------------------------------------------------------------
+
+
+def _wait_dead(topo, dead_id, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if dead_id in topo.sched_po.van.dead_nodes():
+            return
+        time.sleep(0.1)
+    assert dead_id in topo.sched_po.van.dead_nodes()
+
+
+def _revive_server(topo, **cfg_kw):
+    revived = KVStoreDistServer(topo._cfg(role="server", **cfg_kw))
+    t = threading.Thread(target=revived.run, daemon=True)
+    t.start()
+    topo.threads.append(t)
+    for _ in range(300):
+        if revived._ready.is_set():
+            break
+        time.sleep(0.1)
+    assert revived._ready.is_set(), "revived server never became ready"
+    return revived
+
+
+def _pull_now(kv, key, like):
+    out = np.zeros_like(like)
+    kv.pull(key, out=out)
+    kv.wait()
+    return out
+
+
+def test_server_recovers_state_from_snapshot(tmp_path):
+    """Durable recovery, single tier: the server dies AFTER training made
+    progress; the replacement restores weights + optimizer from its
+    periodic snapshot and serves the PRE-CRASH values with NO re-init and
+    NO optimizer re-ship (contrast: test_server_dies_and_recovers_mid_
+    training above documents the old volatile-store behavior)."""
+    topo = SingleTier(extra={"snapshot_dir": str(tmp_path),
+                             "snapshot_interval_s": 0.1}).start()
+    w0 = np.full(8, 4.0, np.float32)
+    try:
+        rank0 = next(kv for kv in topo.workers if kv.rank == 0)
+        rank0.set_optimizer(SGD(learning_rate=1.0))
+        _parallel([lambda kv=kv: kv.init(0, w0) for kv in topo.workers])
+        for r in (1, 2):
+            _parallel([lambda kv=kv, r=r: _round(kv, 0, w0, w0 - 2.0 * r)
+                       for kv in topo.workers])
+        time.sleep(0.5)                  # several snapshot ticks
+        assert topo.server.replication.num_snapshots > 0
+
+        dead_id = topo.server.po_local.my_id
+        topo.server.crash()              # hard kill: no flush, no barrier
+        _wait_dead(topo, dead_id)
+
+        revived = _revive_server(topo)
+        assert revived.po_local.van.is_recovery
+        assert revived.po_local.my_id == dead_id
+        assert revived.replication.restored_from == "snapshot"
+
+        # pre-crash weights, straight from the restored store
+        for kv in topo.workers:
+            np.testing.assert_allclose(_pull_now(kv, 0, w0), w0 - 4.0)
+        # training continues (restored updater applies round 3)
+        _parallel([lambda kv=kv: _round(kv, 0, w0, w0 - 6.0)
+                   for kv in topo.workers])
+        topo.server = revived
+    finally:
+        _parallel([kv.close for kv in topo.workers])
+        for t in topo.threads:
+            t.join(30)
+        if topo.errors:
+            raise topo.errors[0]
+
+
+def test_server_recovers_state_from_peer_replica():
+    """Diskless multi-server recovery: NO snapshot dir — each server
+    replicates its dirty state to the next-rank peer every tick, and the
+    revived server restores by fetching its replica from that peer
+    (Command.REPLICA_FETCH)."""
+    topo = SingleTier(extra={"snapshot_interval_s": 0.1},
+                      num_servers=2).start()
+    w0 = np.full(8, 4.0, np.float32)
+    try:
+        rank0 = next(kv for kv in topo.workers if kv.rank == 0)
+        rank0.set_optimizer(SGD(learning_rate=1.0))
+        _parallel([lambda kv=kv: kv.init(0, w0) for kv in topo.workers])
+        for r in (1, 2):
+            _parallel([lambda kv=kv, r=r: _round(kv, 0, w0, w0 - 2.0 * r)
+                       for kv in topo.workers])
+        time.sleep(0.6)                  # replica deltas propagate
+
+        # the victim is whichever server actually holds key 0's shard
+        from geomx_tpu.kvstore import sharding
+
+        owner = sharding.assign(0, w0.size, 2,
+                                topo._cfg().bigarray_bound)[0].server_rank
+        victim = next(s for s in topo.servers
+                      if s.po_local.my_rank == owner)
+        dead_id = victim.po_local.my_id
+        victim.crash()
+        _wait_dead(topo, dead_id)
+
+        revived = _revive_server(topo)
+        assert revived.po_local.van.is_recovery
+        assert revived.po_local.my_id == dead_id
+        assert revived.replication.restored_from == "replica"
+
+        for kv in topo.workers:
+            np.testing.assert_allclose(_pull_now(kv, 0, w0), w0 - 4.0)
+        _parallel([lambda kv=kv: _round(kv, 0, w0, w0 - 6.0)
+                   for kv in topo.workers])
+        topo.servers = [revived if s is victim else s
+                        for s in topo.servers]
+        topo.server = topo.servers[0]
+    finally:
+        _parallel([kv.close for kv in topo.workers])
+        for t in topo.threads:
+            t.join(30)
+        if topo.errors:
+            raise topo.errors[0]
+
+
+def test_hips_party_server_recovers_state(tmp_path):
+    """Two-tier HiPS: a party server dies between rounds; its replacement
+    restores the party's cached model from its snapshot and serves the
+    pre-crash values, then a full cross-party round completes."""
+    from geomx_tpu.simulate import InProcessHiPS
+
+    extra = dict(HB)
+    extra.update(snapshot_dir=str(tmp_path), snapshot_interval_s=0.1)
+    sim = InProcessHiPS(num_parties=2, workers_per_party=1,
+                        extra_cfg=extra)
+    sim.start(sync_global=True)
+    try:
+        w0 = np.full(6, 8.0, np.float32)
+        sim.master.set_optimizer(SGD(learning_rate=1.0))
+        _parallel([lambda kv=kv: kv.init(0, w0)
+                   for kv in sim.workers + [sim.master]])
+
+        def step(kv, r):
+            kv.push(0, np.ones_like(w0))
+            out = np.zeros_like(w0)
+            kv.pull(0, out=out)
+            kv.wait()
+            np.testing.assert_allclose(out, w0 - 2.0 * r)
+
+        for r in (1, 2):
+            sim.run_workers(lambda kv, r=r: step(kv, r))
+        time.sleep(0.5)                  # snapshot ticks on every server
+
+        # kill the SECOND party's server (servers[0] is the global server)
+        victim = sim.servers[2]
+        assert not victim.is_global_server
+        victim.crash()
+        time.sleep(3.0)                  # heartbeat lapse on BOTH tiers
+
+        revived = KVStoreDistServer(victim.cfg)
+        rt = threading.Thread(target=revived.run, daemon=True)
+        rt.start()
+        sim.threads.append(rt)
+        for _ in range(300):
+            if revived._ready.is_set():
+                break
+            time.sleep(0.1)
+        assert revived._ready.is_set(), "revived party server not ready"
+        assert revived.po_local.van.is_recovery
+        assert revived.po_global is not None
+        assert revived.po_global.van.is_recovery
+        assert revived.replication.restored_from == "snapshot"
+
+        # the party behind the revived server sees pre-crash values
+        kv1 = sim.workers[1]
+        out = np.zeros_like(w0)
+        kv1.pull(0, out=out)
+        kv1.wait()
+        np.testing.assert_allclose(out, w0 - 4.0)
+
+        # and a full cross-party round still completes exactly
+        sim.run_workers(lambda kv: step(kv, 3))
+        sim.servers[2] = revived
+    finally:
+        sim.stop()
+
+
+@pytest.mark.chaos
+def test_faultplan_crash_resume_matches_uninterrupted(tmp_path):
+    """THE acceptance scenario: run A trains 3 rounds uninterrupted; run
+    B is identical but a FaultPlan crash primitive kills the server on
+    the first data frame of round 3. The replacement restores from the
+    periodic snapshot, the workers' retransmits complete round 3, and
+    the final pulled weights EQUAL run A's — restored from state, not
+    re-initialized (no re-init or optimizer re-ship happens in run B
+    after the crash)."""
+    w0 = np.full(8, 4.0, np.float32)
+    common = {
+        "snapshot_dir": None,            # per-run below
+        "snapshot_interval_s": 0.1,
+        "resend": True,
+        "resend_timeout_ms": 2000,       # generous: no spurious resends
+        "ps_seed": 7,
+    }
+    server_id = psbase.server_rank_to_id(0)
+
+    def train_two_rounds(topo):
+        rank0 = next(kv for kv in topo.workers if kv.rank == 0)
+        rank0.set_optimizer(SGD(learning_rate=1.0))
+        _parallel([lambda kv=kv: kv.init(0, w0) for kv in topo.workers])
+        for r in (1, 2):
+            _parallel([lambda kv=kv, r=r: _round(kv, 0, w0, w0 - 2.0 * r)
+                       for kv in topo.workers])
+        time.sleep(0.5)                  # quiesce + snapshot ticks
+
+    # -- run A: uninterrupted baseline ---------------------------------
+    extra_a = dict(common, snapshot_dir=str(tmp_path / "a"))
+    del extra_a["ps_seed"]               # seedless is fine without a plan
+    topo_a = SingleTier(extra=extra_a).start()
+    try:
+        train_two_rounds(topo_a)
+        # data frames the server received through rounds 1-2: the crash
+        # point for run B is the NEXT one (round 3's first arrival)
+        n_pre = topo_a.server.po_local.van.num_data_recv
+        final_a = []
+        _parallel([lambda kv=kv: final_a.append(
+            _pull_now(kv, 0, w0)) for kv in topo_a.workers])
+        _parallel([lambda kv=kv: _round(kv, 0, w0, w0 - 6.0)
+                   for kv in topo_a.workers])
+        expect = w0 - 6.0
+    finally:
+        _parallel([kv.close for kv in topo_a.workers])
+        for t in topo_a.threads:
+            t.join(30)
+        if topo_a.errors:
+            raise topo_a.errors[0]
+    np.testing.assert_allclose(final_a[0], w0 - 4.0)
+
+    # -- run B: same training, server crashed by the fault plan --------
+    plan = json.dumps({"rules": [{
+        "type": "crash", "node": server_id, "at": n_pre + 1,
+        "on": "recv", "tier": "local"}]})
+    extra_b = dict(common, snapshot_dir=str(tmp_path / "b"),
+                   fault_plan=plan)
+    topo_b = SingleTier(extra=extra_b).start()
+    try:
+        train_two_rounds(topo_b)
+        dead_id = topo_b.server.po_local.my_id
+        assert dead_id == server_id
+
+        # round 3: the first data frame trips the crash rule
+        outs = {}
+
+        def round3(kv):
+            kv.push(0, np.ones_like(w0))
+            out = np.zeros_like(w0)
+            kv.pull(0, out=out)
+            kv.wait(timeout=120.0)
+            outs[kv.rank] = out
+
+        ts = [threading.Thread(target=round3, args=(kv,), daemon=True)
+              for kv in topo_b.workers]
+        for t in ts:
+            t.start()
+        _wait_dead(topo_b, dead_id, timeout=30.0)
+        assert topo_b.server._crashed, "FaultPlan crash did not fire"
+
+        # the replacement gets NO fault plan (fresh host) but the same
+        # snapshot dir; workers' retransmits then complete round 3
+        revived = _revive_server(topo_b, fault_plan="")
+        assert revived.po_local.van.is_recovery
+        assert revived.replication.restored_from == "snapshot", \
+            "run B must resume from the snapshot, not re-init"
+        for t in ts:
+            t.join(120)
+        assert set(outs) == {0, 1}, "round 3 did not complete after revival"
+        for rank, out in outs.items():
+            np.testing.assert_allclose(out, expect, err_msg=(
+                f"worker {rank}: resumed weights diverge from the "
+                f"uninterrupted run"))
+        topo_b.server = revived
+    finally:
+        _parallel([kv.close for kv in topo_b.workers])
+        for t in topo_b.threads:
+            t.join(30)
+        if topo_b.errors:
+            raise topo_b.errors[0]

@@ -1,11 +1,16 @@
-"""Resender (ACK/retransmit) tests under PS_DROP_MSG fault injection.
+"""Resender (ACK/retransmit) tests under deterministic fault injection.
 
 Mirrors the reference pairing of ``PS_DROP_MSG`` random message drops
 (van.cc:498-499, 871-877) with the ACK resender (resender.h:15-141): a
 lossy transport with resend enabled must still complete every push/pull,
 and retransmits must not double-apply server-side aggregation.
+
+Loss is injected through the declarative FaultPlan layer (a seeded
+``drop`` rule) rather than the legacy uniform ``drop_rate``, so every
+run sees the same drop schedule and failures reproduce byte-for-byte.
 """
 
+import json
 import threading
 
 import numpy as np
@@ -21,10 +26,16 @@ from test_transport import free_port, shutdown
 
 
 def make_lossy_tier(drop_rate, num_workers=2, num_servers=1,
-                    resend_timeout_ms=100):
+                    resend_timeout_ms=100, seed=1234):
     port = free_port()
-    cfg = Config(drop_rate=drop_rate, resend=True,
-                 resend_timeout_ms=resend_timeout_ms)
+    kw_cfg = dict(resend=True, resend_timeout_ms=resend_timeout_ms,
+                  ps_seed=seed)
+    if drop_rate:
+        # seeded drop rule: same schedule on every run (control frames
+        # are exempt by default, so rendezvous always completes)
+        kw_cfg["fault_plan"] = json.dumps(
+            {"rules": [{"type": "drop", "p": drop_rate}]})
+    cfg = Config(**kw_cfg)
     kw = dict(is_global=False, root_uri="127.0.0.1", root_port=port,
               num_workers=num_workers, num_servers=num_servers, cfg=cfg)
     sched = Postoffice(my_role=Role.SCHEDULER, **kw)
