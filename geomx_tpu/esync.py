@@ -43,16 +43,25 @@ __all__ = ["ESyncStateServer", "ESyncTrainer"]
 
 EMA_ALPHA = 0.5
 DEFAULT_CAP = 32
+# reports older than this many sync rounds (times the slowest reach
+# time) are a departed/crashed worker: its stale entry must not keep
+# inflating `reach` — which would pin every surviving worker's step
+# count to a ghost forever
+STALE_ROUNDS = 4
 
 
 class ESyncStateServer:
     """Per-worker reach-time table + step-count assignment (state server
     role from the paper, hosted inside the rank-0 PS)."""
 
-    def __init__(self, cap: int = DEFAULT_CAP):
+    def __init__(self, cap: int = DEFAULT_CAP,
+                 stale_rounds: float = STALE_ROUNDS,
+                 time_fn=time.monotonic):
         self.cap = cap
+        self.stale_rounds = float(stale_rounds)
+        self._time_fn = time_fn          # injectable for tests
         self._lock = threading.Lock()
-        # sender id -> (tau_ema, c_ema)
+        # sender id -> (tau_ema, c_ema, last_report_time)
         self._times: Dict[int, tuple] = {}
 
     def report(self, sender: int, tau_s: float, c_s: float) -> int:
@@ -60,15 +69,28 @@ class ESyncStateServer:
         local step count."""
         tau_s = max(float(tau_s), 1e-6)
         c_s = max(float(c_s), 0.0)
+        now = self._time_fn()
         with self._lock:
             prev = self._times.get(sender)
             if prev is not None:
                 tau_s = EMA_ALPHA * tau_s + (1 - EMA_ALPHA) * prev[0]
                 c_s = EMA_ALPHA * c_s + (1 - EMA_ALPHA) * prev[1]
-            self._times[sender] = (tau_s, c_s)
-            reach = max(t + c for t, c in self._times.values())
+            self._times[sender] = (tau_s, c_s, now)
+            # age out the dead: a worker reports once per sync round and
+            # a round lasts about the balanced reach time T, so anything
+            # silent for stale_rounds * T rounds has left the job
+            reach_all = max(t + c for t, c, _ in self._times.values())
+            window = max(self.stale_rounds * reach_all, 1e-3)
+            self._times = {s: e for s, e in self._times.items()
+                           if now - e[2] <= window}
+            reach = max(t + c for t, c, _ in self._times.values())
             m = int((reach - c_s) / tau_s)
         return max(1, min(m, self.cap))
+
+    def live_workers(self) -> int:
+        """Number of workers with a non-stale report (observability)."""
+        with self._lock:
+            return len(self._times)
 
     def handle(self, body: str, sender: int) -> str:
         """Command-channel entry: body = JSON {"tau": s, "c": s};

@@ -455,11 +455,13 @@ class KVStoreDist(KVStore):
                     # a server acked without data (e.g. a range the
                     # store doesn't hold): NEVER copy the zero-filled
                     # buffer over the caller's params — fall back to an
-                    # explicit pull for this key
+                    # explicit pull for this key, at the caller's own
+                    # priority so the retry doesn't queue behind traffic
+                    # the original request was meant to beat
                     fallback.append(k)
             if fallback:
                 self._pull_batch(fallback,
-                                 [out_of[k] for k in fallback], 0)
+                                 [out_of[k] for k in fallback], priority)
             # the ack also advances the push-ordering bookkeeping so a
             # subsequent plain pull stays ordered after this round
             ready = []

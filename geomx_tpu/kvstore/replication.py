@@ -167,15 +167,19 @@ class ReplicationManager:
             with st.lock:
                 if not st.initialized or st.stored is None:
                     continue
-                if self._snap_versions.get((key, off), -1) == st.version:
-                    continue
+                # _snap_versions is shared with _apply (restore path) and
+                # guarded by self._lock there; taking it here too keeps
+                # the pair ordered st.lock -> self._lock on both paths
+                with self._lock:
+                    if self._snap_versions.get((key, off), -1) == st.version:
+                        continue
+                    self._snap_versions[(key, off)] = st.version
                 out[(key, off)] = {
                     "v": np.array(st.stored),
                     "total": int(st.total),
                     "version": int(st.version),
                     "rounds": int(st.rounds),
                 }
-                self._snap_versions[(key, off)] = st.version
         return out
 
     def _updater_blobs(self) -> Tuple[bytes, bytes]:

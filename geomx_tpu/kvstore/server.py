@@ -133,8 +133,16 @@ class _BatchResponder:
         if not parts:
             self._srv.response(req)
             return
-        merged = KVPairs(compr=next((p.compr for p in parts if p.compr),
-                                    ""))
+        # one merged response carries ONE compr tag; per-key machines
+        # answering the same request with different codecs would make the
+        # worker decompress every part with whichever tag won — corrupt
+        # pulls. Divergence is a server-side logic bug: fail loudly.
+        tags = {p.compr for p in parts if p.compr}
+        if len(tags) > 1:
+            raise ValueError(
+                f"_BatchResponder: divergent compr tags {sorted(tags)} "
+                f"across per-key parts of one merged response")
+        merged = KVPairs(compr=next(iter(tags), ""))
         for p in parts:
             for i in range(len(p.keys)):
                 merged.keys.append(p.keys[i])
@@ -475,46 +483,16 @@ class KVStoreDistServer:
         for i, key in enumerate(kvs.keys):
             off = kvs.offset_of(i)
             total = kvs.total_of(i)
-            if tagging:
-                _tag = profiler.scope(
-                    f"{'push' if req.push else 'pull'}:key{key}",
-                    cat="kvstore.op", offset=off)
-                _tag.__enter__()
-            if req.push:
-                val = np.asarray(kvs.vals[i]).ravel()
-                if kvs.compr:
-                    with profiler.scope(f"decompress:{kvs.compr}",
-                                        cat="kvstore.op") if tagging \
-                            else _null_ctx():
-                        val = self.gc.decompress_push(
-                            kvs.compr, val, kvs.aux[i],
-                            kvs.len_of(i) or val.size)
-                total = total or val.size
-                with self._lock:
-                    self._key_total[key] = max(self._key_total.get(key, 0),
-                                               total)
-                if global_store:
-                    acts += self._push_global_store(
-                        req, srv, key, off, val, total, global_tier)
-                else:
-                    st = self._state(key, off)
-                    with st.lock:
-                        acts += self._push_local_store(req, srv, key, off,
-                                                       val, total)
-            elif req.pull:
-                length = kvs.len_of(i)
-                aux = kvs.aux[i] if i < len(kvs.aux) else None
-                if global_store:
-                    acts += self._pull_global_store(
-                        req, srv, key, off, length, total, kvs.compr, aux)
-                else:
-                    st = self._state(key, off)
-                    with st.lock:
-                        acts += self._pull_local_store(req, srv, key, off,
-                                                       length, kvs.compr,
-                                                       aux)
-            if tagging:
-                _tag.__exit__(None, None, None)
+            # a real `with` (not a bare __enter__/__exit__ pair): a raise
+            # in key handling must still close the span, or the profiler
+            # trace shows a span covering every later request
+            _tag = profiler.scope(
+                f"{'push' if req.push else 'pull'}:key{key}",
+                cat="kvstore.op", offset=off) if tagging else _null_ctx()
+            with _tag:
+                self._handle_one_key(req, kvs, srv, global_store,
+                                     global_tier, acts, i, key, off,
+                                     total, tagging)
         if collect:
             try:
                 for fn in acts:
@@ -526,6 +504,44 @@ class KVStoreDistServer:
         else:
             for fn in acts:
                 fn()
+
+    def _handle_one_key(self, req, kvs, srv, global_store, global_tier,
+                        acts, i, key, off, total, tagging) -> None:
+        """One (key, shard-offset) entry of a data request (the loop body
+        of :meth:`_handle_data`)."""
+        if req.push:
+            val = np.asarray(kvs.vals[i]).ravel()
+            if kvs.compr:
+                with profiler.scope(f"decompress:{kvs.compr}",
+                                    cat="kvstore.op") if tagging \
+                        else _null_ctx():
+                    val = self.gc.decompress_push(
+                        kvs.compr, val, kvs.aux[i],
+                        kvs.len_of(i) or val.size)
+            total = total or val.size
+            with self._lock:
+                self._key_total[key] = max(self._key_total.get(key, 0),
+                                           total)
+            if global_store:
+                acts += self._push_global_store(
+                    req, srv, key, off, val, total, global_tier)
+            else:
+                st = self._state(key, off)
+                with st.lock:
+                    acts += self._push_local_store(req, srv, key, off,
+                                                   val, total)
+        elif req.pull:
+            length = kvs.len_of(i)
+            aux = kvs.aux[i] if i < len(kvs.aux) else None
+            if global_store:
+                acts += self._pull_global_store(
+                    req, srv, key, off, length, total, kvs.compr, aux)
+            else:
+                st = self._state(key, off)
+                with st.lock:
+                    acts += self._pull_local_store(req, srv, key, off,
+                                                   length, kvs.compr,
+                                                   aux)
 
     # ------------------------------------------------------------------
     # party (intra-DC) server: push (reference: DataHandleSyncDefault)

@@ -94,7 +94,6 @@ class FSDPTrainer:
                     jnp.sum(jax.nn.log_softmax(logits) * one_hot,
                             axis=-1))
 
-        @jax.jit
         def train_step(p, opt_state, X, y):
             loss, grads = jax.value_and_grad(loss_fn)(p, X, y)
             updates, opt_state = optimizer.update(grads, opt_state, p)
@@ -102,9 +101,11 @@ class FSDPTrainer:
 
         # out_shardings pin the updated params/state back to their
         # shards so the weight update runs shard-local (ZeRO-3): without
-        # them XLA could legally materialize replicated outputs
+        # them XLA could legally materialize replicated outputs.
+        # donate_argnums releases the old param/opt-state shards for
+        # in-place reuse — step() rebinds both every call
         self._train_step = jax.jit(
-            train_step,
+            train_step, donate_argnums=(0, 1),
             out_shardings=(self.param_shardings, self.opt_shardings,
                            NamedSharding(mesh, P())))
 

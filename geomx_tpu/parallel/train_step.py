@@ -12,6 +12,7 @@ touches the WAN.
 
 from __future__ import annotations
 
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -42,7 +43,10 @@ class DataParallelTrainer:
             return -jnp.mean(
                 jnp.sum(jax.nn.log_softmax(logits) * one_hot, axis=-1))
 
-        @jax.jit
+        # donate the incoming params/opt-state: step() rebinds both to
+        # the outputs, so XLA may update the old buffers in place
+        # instead of holding two copies live across the update
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def train_step(p, opt_state, X, y):
             loss, grads = jax.value_and_grad(loss_fn)(p, X, y)
             updates, opt_state = optimizer.update(grads, opt_state, p)

@@ -233,8 +233,15 @@ class Meta:
 # node of a deployment must run the same build (the launch scripts
 # ship one tree to all roles, and the reference's protobuf meta makes
 # the same same-build assumption in practice). Reorders/appends are
-# fine within one build; a mixed-version cluster is not supported.
+# fine within one build; a mixed-version cluster is not supported —
+# and to make THAT failure mode loud instead of a garbled-field crash
+# three layers up, the region leads with a one-byte codec version
+# (BINMETA_VERSION). Bump it whenever _META_FIELDS changes order or an
+# entry's wire kind; a mismatched peer is rejected with an explicit
+# version-mismatch ValueError at decode.
 # ---------------------------------------------------------------------------
+
+BINMETA_VERSION = 1
 
 _META_FIELDS: List[Tuple[str, str]] = [
     ("sender", "i"), ("app_id", "i"), ("customer_id", "i"),
@@ -257,7 +264,7 @@ _F64 = struct.Struct("<d")
 
 
 def _encode_meta_bin(meta: "Meta") -> bytes:
-    out: List[bytes] = []
+    out: List[bytes] = [bytes((BINMETA_VERSION,))]
     ap = out.append
     for fid, (name, kind) in enumerate(_META_FIELDS):
         v = getattr(meta, name)
@@ -295,9 +302,17 @@ def _encode_meta_bin(meta: "Meta") -> bytes:
 
 def _decode_meta_bin(buf) -> "Meta":
     m = Meta()
-    off = 0
     n = len(buf)
     mv = memoryview(buf)
+    if n < 1:
+        raise ValueError("binary meta: empty region (no codec version)")
+    ver = mv[0]
+    if ver != BINMETA_VERSION:
+        raise ValueError(
+            f"binary meta codec version mismatch: peer speaks v{ver}, "
+            f"this build speaks v{BINMETA_VERSION} — all nodes of a "
+            f"deployment must run the same build")
+    off = 1
     while off < n:
         fid = mv[off]
         off += 1

@@ -1,0 +1,7 @@
+#!/bin/bash
+# geomx-lint from any cwd: lock, traced-code and config-drift analysis.
+# Flags pass through, e.g.:  scripts/run_analyze.sh --passes traced --json
+# See docs/static-analysis.md for the rule catalogue + baseline workflow.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m tools.analyze "$@"

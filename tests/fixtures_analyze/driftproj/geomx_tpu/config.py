@@ -1,0 +1,8 @@
+"""Miniature config registry for the config-drift fixture tree."""
+
+
+def load():
+    return dict(
+        documented=env_int("PS_DOCUMENTED", 1),
+        undocumented=env_str("PS_UNDOCUMENTED", ""),   # GX-C201: no doc row
+    )

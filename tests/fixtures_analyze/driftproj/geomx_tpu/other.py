@@ -1,0 +1,5 @@
+"""A module that bypasses the config registry."""
+
+import os
+
+RAW_FLAG = os.environ.get("PS_RAW_FLAG", "0")   # GX-C203 (+ GX-C201: undocumented)

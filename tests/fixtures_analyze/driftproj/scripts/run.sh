@@ -1,0 +1,4 @@
+#!/bin/bash
+# Fixture launch script for the config-drift pass.
+export PS_DOCUMENTED=2
+DMLC_DEAD_KNOB=1 python -c 'pass'   # GX-C204: nothing in code/doc knows this knob

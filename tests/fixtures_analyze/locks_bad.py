@@ -1,0 +1,74 @@
+"""Seeded concurrency violations — analyzed, never imported."""
+
+import threading
+import time
+
+
+class Inverted:
+    """GX-L001: ab() orders a->b, ba() orders b->a."""
+
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+        self.counter = 0
+        self.t = threading.Thread(target=self.unguarded)
+
+    def ab(self):
+        with self.a:
+            with self.b:
+                self.counter += 1          # guarded write (under a, b)
+
+    def ba(self):
+        with self.b:
+            with self.a:
+                pass
+
+    def unguarded(self):
+        self.counter = 0                   # GX-L002: no lock held
+
+    def blocking(self):
+        with self.a:
+            time.sleep(0.1)                # GX-L003: sleep under a
+            self.t.join()                  # GX-L003: thread join under a
+
+    def reenter_lexical(self):
+        with self.a:
+            with self.a:                   # GX-L004: Lock is not reentrant
+                pass
+
+    def reenter_via_call(self):
+        with self.b:
+            self._helper()                 # GX-L004: helper retakes b
+
+    def _helper(self):
+        with self.b:
+            pass
+
+
+class CvHolder:
+    """Condition.wait released correctly vs while holding another lock."""
+
+    def __init__(self):
+        self.m = threading.Lock()
+        self.cv = threading.Condition()
+
+    def ok_wait(self):
+        with self.cv:
+            self.cv.wait()                 # fine: wait releases cv itself
+
+    def bad_wait(self):
+        with self.m:
+            with self.cv:
+                self.cv.wait()             # GX-L003: m stays held asleep
+
+
+class CleanRLock:
+    """Re-entry on an RLock is legal — must NOT fire GX-L004."""
+
+    def __init__(self):
+        self.r = threading.RLock()
+
+    def nested(self):
+        with self.r:
+            with self.r:
+                pass

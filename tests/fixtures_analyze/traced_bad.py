@@ -1,0 +1,45 @@
+"""Seeded traced-code violations — analyzed, never imported."""
+
+import functools
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def hot(x):
+    y = helper(x)
+    return float(y) + y.item()             # GX-J101 twice (float, .item)
+
+
+def helper(x):
+    # traced transitively: hot() calls it
+    return np.asarray(x) * 2               # GX-J101 (np.asarray on tracer)
+
+
+def looped(xs):
+    out = []
+    for x in xs:
+        out.append(jax.jit(lambda v: v * 2)(x))   # GX-J102: loop + inline
+    return out
+
+
+@jax.jit
+def train_step(params, opt_state, batch):  # GX-J103: returns state, no donate
+    params = params
+    return params, opt_state, 0.0
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def good_step(params, opt_state, batch):   # clean: donates its state
+    return params, opt_state, 1.0
+
+
+@jax.jit
+def grad_like_step(params, batch):         # clean: param only used, not passed through
+    return np.tanh
+
+
+@jax.jit
+def static_ok(x):
+    return int(x.shape[0])                 # clean: shapes are static

@@ -1,0 +1,250 @@
+"""Pytest gate for geomx-lint (tools/analyze).
+
+Two jobs:
+
+1. Prove every rule fires — each rule id is exercised against the
+   seeded-violation fixtures in tests/fixtures_analyze/ (which also
+   carry clean counterparts that must stay clean).
+2. Gate the real tree — ``run_all`` over geomx_tpu/ must produce zero
+   findings beyond the committed baseline, and the baseline must carry
+   no stale entries (every accepted fingerprint still corresponds to a
+   live finding).
+
+Pure AST analysis: none of this imports jax or spawns processes beyond
+the one CLI smoke test.
+"""
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools.analyze import (DEFAULT_BASELINE, load_baseline, load_sources,
+                           run_all, run_concurrency, run_config_drift,
+                           run_traced, save_baseline, split_by_baseline)
+from tools.analyze.config_drift import _expand_doc_shorthand
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = Path(__file__).resolve().parent / "fixtures_analyze"
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+def _by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# concurrency pass (GX-L001..L004)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def lock_findings():
+    sources = load_sources([FIXTURES / "locks_bad.py"], FIXTURES)
+    return run_concurrency(sources)
+
+
+def test_lock_order_inversion_fires(lock_findings):
+    hits = _by_rule(lock_findings, "GX-L001")
+    assert len(hits) == 1
+    assert hits[0].symbol == "locks_bad.Inverted"
+    assert hits[0].detail == "a:b"
+
+
+def test_mixed_guarded_unguarded_write_fires(lock_findings):
+    hits = _by_rule(lock_findings, "GX-L002")
+    assert [h.symbol for h in hits] == ["locks_bad.Inverted.counter"]
+    assert "unguarded" in hits[0].message
+
+
+def test_blocking_under_lock_fires(lock_findings):
+    hits = _by_rule(lock_findings, "GX-L003")
+    by_detail = {h.detail: h for h in hits}
+    assert "time.sleep" in by_detail            # sleep under self.a
+    assert "self.t.join" in by_detail           # thread join under self.a
+    # Condition.wait while holding ANOTHER lock is flagged ...
+    assert by_detail["self.cv.wait"].symbol == "bad_wait"
+    # ... but the canonical with-cv: cv.wait() pattern is not
+    assert all(h.symbol != "ok_wait" for h in hits)
+
+
+def test_reentrant_lock_fires(lock_findings):
+    hits = _by_rule(lock_findings, "GX-L004")
+    symbols = {h.symbol for h in hits}
+    assert "reenter_lexical" in symbols         # with a: with a:
+    assert "reenter_via_call" in symbols        # helper retakes b
+    # RLock re-entry is legal and must stay clean
+    assert all(h.detail != "r" for h in hits)
+
+
+# ---------------------------------------------------------------------------
+# traced pass (GX-J101..J103)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def traced_findings():
+    sources = load_sources([FIXTURES / "traced_bad.py"], FIXTURES)
+    return run_traced(sources)
+
+
+def test_host_sync_fires(traced_findings):
+    hits = _by_rule(traced_findings, "GX-J101")
+    names = {h.detail.split(":")[0] for h in hits}
+    assert {"float", "y.item"} <= names          # directly in hot()
+    # np.asarray is reached transitively: hot() -> helper()
+    assert any(h.symbol == "helper" and "np.asarray" in h.detail
+               for h in hits)
+    # shape arithmetic is static under tracing — never flagged
+    assert all(h.symbol != "static_ok" for h in hits)
+
+
+def test_retrace_hazard_fires(traced_findings):
+    hits = _by_rule(traced_findings, "GX-J102")
+    details = {h.detail for h in hits}
+    assert "inline-call" in details              # jax.jit(f)(x)
+    assert any(d.startswith("loop:") for d in details)
+    assert all(h.symbol == "looped" for h in hits)
+
+
+def test_missing_donate_fires(traced_findings):
+    hits = _by_rule(traced_findings, "GX-J103")
+    assert [h.symbol for h in hits] == ["train_step"]
+    # donated, non-state-returning, and static functions all stay clean
+
+
+# ---------------------------------------------------------------------------
+# config-drift pass (GX-C201..C204)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def drift_findings():
+    root = FIXTURES / "driftproj"
+    sources = load_sources([root / "geomx_tpu"], root)
+    return run_config_drift(sources, root)
+
+
+def test_undocumented_knob_fires(drift_findings):
+    hits = _by_rule(drift_findings, "GX-C201")
+    names = {h.symbol for h in hits}
+    assert names == {"PS_UNDOCUMENTED", "PS_RAW_FLAG"}
+    assert "PS_DOCUMENTED" not in names          # registered + documented
+
+
+def test_stale_doc_row_fires(drift_findings):
+    hits = _by_rule(drift_findings, "GX-C202")
+    assert [h.symbol for h in hits] == ["PS_STALE"]
+    assert hits[0].path == "docs/env-var-summary.md"
+
+
+def test_raw_env_read_fires(drift_findings):
+    hits = _by_rule(drift_findings, "GX-C203")
+    assert [h.symbol for h in hits] == ["PS_RAW_FLAG"]
+    assert hits[0].path == "geomx_tpu/other.py"
+
+
+def test_dead_script_knob_fires(drift_findings):
+    hits = _by_rule(drift_findings, "GX-C204")
+    assert [h.symbol for h in hits] == ["DMLC_DEAD_KNOB"]
+    # PS_DOCUMENTED is exported by the same script but IS read — clean
+
+
+def test_doc_shorthand_expansion():
+    assert _expand_doc_shorthand(
+        ["DMLC_PS_GLOBAL_ROOT_URI", "_PORT"]) == \
+        ["DMLC_PS_GLOBAL_ROOT_URI", "DMLC_PS_GLOBAL_ROOT_PORT"]
+    assert _expand_doc_shorthand(["DMLC_K", "_K_MIN"]) == \
+        ["DMLC_K", "DMLC_K_MIN"]
+
+
+# ---------------------------------------------------------------------------
+# plumbing: syntax errors, suppression, baseline
+# ---------------------------------------------------------------------------
+
+def test_syntax_error_becomes_finding(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def broken(:\n", encoding="utf-8")
+    findings = run_all([bad], tmp_path, passes=["concurrency"])
+    assert _rules(findings) == {"GX-E000"}
+
+
+_SLEEPER = textwrap.dedent("""\
+    import threading, time
+
+    class C:
+        def __init__(self):
+            self.l = threading.Lock()
+
+        def m(self):
+            with self.l:
+                time.sleep(1){comment}
+    """)
+
+
+def test_suppression_comment_drops_finding(tmp_path):
+    noisy = tmp_path / "noisy.py"
+    noisy.write_text(_SLEEPER.format(comment=""), encoding="utf-8")
+    assert "GX-L003" in _rules(run_all([noisy], tmp_path,
+                                       passes=["concurrency"]))
+
+    noisy.write_text(
+        _SLEEPER.format(comment="  # geomx-lint: disable=GX-L003"),
+        encoding="utf-8")
+    assert run_all([noisy], tmp_path, passes=["concurrency"]) == []
+
+    # disable=all works too, and an unrelated rule id does not suppress
+    noisy.write_text(
+        _SLEEPER.format(comment="  # geomx-lint: disable=all"),
+        encoding="utf-8")
+    assert run_all([noisy], tmp_path, passes=["concurrency"]) == []
+    noisy.write_text(
+        _SLEEPER.format(comment="  # geomx-lint: disable=GX-L001"),
+        encoding="utf-8")
+    assert "GX-L003" in _rules(run_all([noisy], tmp_path,
+                                       passes=["concurrency"]))
+
+
+def test_baseline_roundtrip_and_split(tmp_path, lock_findings):
+    bl = tmp_path / "baseline.json"
+    save_baseline(bl, lock_findings)
+    baseline = load_baseline(bl)
+    new, accepted = split_by_baseline(lock_findings, baseline)
+    assert new == []
+    assert len(accepted) == len(lock_findings)
+    # fingerprints are line-free: a renumbered finding still matches
+    moved = accepted[0].__class__(**{**vars(accepted[0]),
+                                     "line": accepted[0].line + 40})
+    assert moved.fingerprint in baseline
+
+
+# ---------------------------------------------------------------------------
+# the gate: the real tree is clean against the committed baseline
+# ---------------------------------------------------------------------------
+
+def test_repo_clean_against_committed_baseline():
+    findings = run_all([REPO / "geomx_tpu"], REPO)
+    baseline = load_baseline(DEFAULT_BASELINE)
+    new, accepted = split_by_baseline(findings, baseline)
+    assert new == [], "new findings beyond baseline:\n" + "\n".join(
+        f"  {f.render()}  (fingerprint {f.fingerprint})" for f in new)
+    # no stale baseline entries either: every accepted fingerprint is live
+    assert {f.fingerprint for f in accepted} == baseline
+
+
+def test_cli_smoke():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analyze"], cwd=REPO,
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.startswith("OK: 0 new finding(s)"), proc.stdout
+
+    # seeded violations must fail the gate when the baseline is bypassed
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--no-baseline",
+         str(FIXTURES / "locks_bad.py")], cwd=REPO,
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "FAIL:" in proc.stdout

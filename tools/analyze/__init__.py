@@ -1,0 +1,64 @@
+"""geomx-lint: project-native static analysis for geomx_tpu.
+
+Three AST passes over the tree (no imports of the analyzed code, no
+process spawns — safe to run anywhere, including CI on a box with no
+accelerator):
+
+- **concurrency** (GX-L0xx): lock inventory, per-class lock-acquisition
+  graph, order inversions, unguarded writes to guarded attributes,
+  blocking calls under a lock, re-entrant ``Lock`` acquisition.
+- **traced** (GX-J1xx): hazards in code reachable from
+  ``jax.jit``/``pjit``/``shard_map``: implicit host syncs, per-call
+  retrace patterns, missing ``donate_argnums`` on train steps.
+- **config-drift** (GX-C2xx): env_* registrations vs raw ``os.environ``
+  reads vs docs/env-var-summary.md vs scripts/*.sh.
+
+Run ``python -m tools.analyze`` from the repo root; see
+docs/static-analysis.md for the rule catalogue, baseline workflow and
+suppression syntax.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+from .core import (Finding, SEV_ERROR, SEV_WARNING, SourceFile,
+                   apply_suppressions, load_baseline, load_sources,
+                   save_baseline, sort_findings, split_by_baseline)
+from .concurrency import run_concurrency
+from .config_drift import run_config_drift
+from .traced import run_traced
+
+__all__ = [
+    "Finding", "SEV_ERROR", "SEV_WARNING", "SourceFile",
+    "run_concurrency", "run_traced", "run_config_drift", "run_all",
+    "load_baseline", "save_baseline", "split_by_baseline",
+    "sort_findings", "DEFAULT_BASELINE",
+]
+
+DEFAULT_BASELINE = Path(__file__).with_name("baseline.json")
+
+PASSES = {
+    "concurrency": lambda sources, root: run_concurrency(sources),
+    "traced": lambda sources, root: run_traced(sources),
+    "config-drift": run_config_drift,
+}
+
+
+def run_all(paths: Sequence[Path], root: Path,
+            passes: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the selected passes (default: all) and return suppressed-
+    filtered, sorted findings. Syntax errors in analyzed files surface
+    as GX-E000 findings rather than crashing the run."""
+    sources = load_sources([Path(p) for p in paths], Path(root))
+    findings: List[Finding] = []
+    for src in sources:
+        if src.parse_error is not None:
+            findings.append(Finding(
+                "GX-E000", SEV_ERROR, src.rel,
+                src.parse_error.lineno or 0, symbol="<parse>",
+                message=f"syntax error: {src.parse_error.msg}"))
+    for name in (passes or list(PASSES)):
+        findings += PASSES[name](sources, Path(root))
+    return sort_findings(apply_suppressions(findings, sources))

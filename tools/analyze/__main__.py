@@ -1,0 +1,76 @@
+"""CLI: ``python -m tools.analyze [paths...]``.
+
+Exit codes: 0 = no findings beyond the baseline; 1 = new findings;
+2 = usage error. ``--update-baseline`` rewrites the committed baseline
+to exactly the current findings (do this after fixing or accepting)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from . import (DEFAULT_BASELINE, PASSES, load_baseline, run_all,
+               save_baseline, split_by_baseline)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="geomx-lint: lock, traced-code and config-drift "
+                    "static analysis (docs/static-analysis.md)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to analyze (default: geomx_tpu/)")
+    ap.add_argument("--root", default=".",
+                    help="project root holding docs/ and scripts/ "
+                         "(default: cwd)")
+    ap.add_argument("--passes", default=None,
+                    help="comma list from: %s" % ",".join(PASSES))
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                    help="baseline file (default: tools/analyze/"
+                         "baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, accepted or not")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to the current findings")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    root = Path(args.root)
+    paths = [Path(p) for p in args.paths] or [root / "geomx_tpu"]
+    passes = args.passes.split(",") if args.passes else None
+    unknown = set(passes or []) - set(PASSES)
+    if unknown:
+        print(f"unknown pass(es): {sorted(unknown)}", file=sys.stderr)
+        return 2
+
+    findings = run_all(paths, root, passes)
+
+    if args.update_baseline:
+        save_baseline(Path(args.baseline), findings)
+        print(f"baseline updated: {len(findings)} finding(s) accepted "
+              f"-> {args.baseline}")
+        return 0
+
+    baseline = set() if args.no_baseline else load_baseline(
+        Path(args.baseline))
+    new, accepted = split_by_baseline(findings, baseline)
+
+    if args.json:
+        print(json.dumps({
+            "new": [vars(f) for f in new],
+            "accepted": [vars(f) for f in accepted],
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        tail = (f"{len(new)} new finding(s), {len(accepted)} accepted "
+                f"in baseline")
+        print(("FAIL: " if new else "OK: ") + tail)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,392 @@
+"""Concurrency pass: lock inventory, acquisition graph, hazard rules.
+
+Rules
+-----
+GX-L001 (error)   lock-order inversion: within one class, lock A is taken
+                  while holding B somewhere and B while holding A elsewhere.
+GX-L002 (warning) attribute written both under a guarding lock and outside
+                  any lock (excluding ``__init__``-time construction).
+GX-L003 (warning) blocking call (sleep, socket send/recv/accept/connect,
+                  queue get/put, thread join, Condition.wait on a *different*
+                  lock) made while holding a lock.
+GX-L004 (error)   re-entrant acquisition of a non-reentrant ``Lock`` — a
+                  ``with self.x`` nested (lexically, or one call level deep)
+                  inside a region already holding ``self.x``.
+
+Scope is intentionally per-class (plus module-level locks used by
+module-level functions): ``self.X`` attributes assigned from
+``threading.Lock()/RLock()/Condition()``. A ``Condition(self.y)`` aliases
+its underlying lock, so holding the condition counts as holding ``y``.
+Locks passed across objects or stored in tuples are out of scope — this
+is a linter, not a model checker.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import (Finding, SEV_ERROR, SEV_WARNING, SourceFile, call_name)
+
+_LOCK_CTORS = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+    "Lock": "Lock",
+    "RLock": "RLock",
+    "Condition": "Condition",
+}
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+_QUEUE_CTORS = {"queue.Queue", "Queue", "queue.SimpleQueue", "SimpleQueue",
+                "queue.PriorityQueue", "PriorityQueue"}
+
+# call-name suffixes that block the calling thread
+_BLOCKING_SUFFIXES = (
+    ".recv", ".recv_into", ".recvfrom", ".send", ".sendall", ".sendto",
+    ".accept", ".connect",
+)
+_SLEEP_NAMES = {"time.sleep", "sleep"}
+
+
+@dataclasses.dataclass
+class _LockDef:
+    name: str          # attribute / variable name
+    kind: str          # Lock | RLock | Condition
+    canonical: str     # underlying lock for Condition(self.x); else name
+    line: int
+
+
+@dataclasses.dataclass
+class _Write:
+    method: str
+    line: int
+    held: Tuple[str, ...]
+
+
+class _ScopeInfo:
+    """One analyzed scope: a class (self.X locks) or a module
+    (bare-name locks used by module-level functions)."""
+
+    def __init__(self, qualname: str, prefix: str):
+        self.qualname = qualname          # "van.Van" or "van.<module>"
+        self.prefix = prefix              # "self." or ""
+        self.locks: Dict[str, _LockDef] = {}
+        self.threads: Set[str] = set()
+        self.queues: Set[str] = set()
+        # per-method direct info
+        self.direct_acquires: Dict[str, Set[str]] = {}
+        # (holder, acquired) -> first site line
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        self.guarded_writes: Dict[str, List[_Write]] = {}
+        self.unguarded_writes: Dict[str, List[_Write]] = {}
+        # blocking calls: (method, line, callname, held)
+        self.blocking: List[Tuple[str, int, str, Tuple[str, ...]]] = []
+        # call sites: method -> [(callee, held, line)]
+        self.calls: Dict[str, List[Tuple[str, Tuple[str, ...], int]]] = {}
+        # lexically nested re-acquisitions: (method, line, lock)
+        self.reacquired: List[Tuple[str, int, str]] = []
+
+    def canon(self, name: str) -> Optional[str]:
+        d = self.locks.get(name)
+        return d.canonical if d else None
+
+    def kind_of(self, canonical: str) -> str:
+        d = self.locks.get(canonical)
+        return d.kind if d else "Lock"
+
+
+def _target_attr(node: ast.AST, prefix_self: bool) -> Optional[str]:
+    """Attribute name written by an assignment target (``self.x``,
+    ``self.x[...]``), or bare name for module scope."""
+    if isinstance(node, (ast.Subscript,)):
+        return _target_attr(node.value, prefix_self)
+    if prefix_self:
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        return None
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _lock_ref(expr: ast.AST, scope: _ScopeInfo) -> Optional[str]:
+    """Canonical lock name when ``expr`` references a known lock
+    (``self.x`` in a class scope, ``x`` in module scope)."""
+    if scope.prefix == "self.":
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            return scope.canon(expr.attr)
+        return None
+    if isinstance(expr, ast.Name):
+        return scope.canon(expr.id)
+    return None
+
+
+def _collect_locks(scope: _ScopeInfo, bodies: Sequence[ast.AST],
+                   prefix_self: bool) -> None:
+    for body in bodies:
+        for node in ast.walk(body):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            attr = _target_attr(node.targets[0], prefix_self)
+            if attr is None or not isinstance(node.value, ast.Call):
+                continue
+            cname = call_name(node.value.func)
+            kind = _LOCK_CTORS.get(cname)
+            if kind is not None:
+                canonical = attr
+                if kind == "Condition" and node.value.args:
+                    under = _target_attr(node.value.args[0], prefix_self)
+                    if under is not None:
+                        canonical = under
+                scope.locks[attr] = _LockDef(attr, kind, canonical,
+                                             node.lineno)
+            elif cname in _THREAD_CTORS:
+                scope.threads.add(attr)
+            elif cname in _QUEUE_CTORS:
+                scope.queues.add(attr)
+    # re-canonicalize conditions declared before their underlying lock
+    for d in scope.locks.values():
+        seen = set()
+        while (d.canonical in scope.locks
+               and scope.locks[d.canonical].canonical != d.canonical
+               and d.canonical not in seen):
+            seen.add(d.canonical)
+            d.canonical = scope.locks[d.canonical].canonical
+
+
+def _is_blocking(scope: _ScopeInfo, node: ast.Call,
+                 held: Tuple[str, ...]) -> Optional[str]:
+    """Return a printable call name when ``node`` may block."""
+    name = call_name(node.func)
+    if not name:
+        return None
+    if name in _SLEEP_NAMES:
+        return name
+    if name.endswith(_BLOCKING_SUFFIXES):
+        return name
+    if name.endswith((".wait", ".wait_for")):
+        owner = name.rsplit(".", 1)[0]
+        # Condition.wait RELEASES the lock it owns: waiting on the only
+        # held lock is the normal pattern; waiting while holding another
+        # lock keeps that other lock across the sleep.
+        attr = owner.split(".", 1)[1] if owner.startswith("self.") \
+            else owner
+        canonical = scope.canon(attr)
+        others = [h for h in held if h != canonical]
+        if others:
+            return name
+        return None
+    if name.endswith(".join"):
+        owner = name.rsplit(".", 1)[0]
+        attr = owner.split(".", 1)[1] if owner.startswith("self.") \
+            else owner
+        if attr in scope.threads:
+            return name
+        return None
+    if name.endswith((".get", ".put")):
+        owner = name.rsplit(".", 1)[0]
+        attr = owner.split(".", 1)[1] if owner.startswith("self.") \
+            else owner
+        if attr in scope.queues:
+            return name
+        return None
+    return None
+
+
+def _scan_method(scope: _ScopeInfo, method_name: str,
+                 fn: ast.AST) -> None:
+    """Walk one method/function body tracking the held-lock stack."""
+    scope.direct_acquires.setdefault(method_name, set())
+    scope.calls.setdefault(method_name, [])
+    is_init = method_name.rsplit(".", 1)[-1] in ("__init__", "__post_init__",
+                                                 "__new__")
+
+    def record_write(attr: str, line: int, held: Tuple[str, ...]):
+        if attr in scope.locks or is_init:
+            return
+        w = _Write(method_name, line, held)
+        if held:
+            scope.guarded_writes.setdefault(attr, []).append(w)
+        else:
+            scope.unguarded_writes.setdefault(attr, []).append(w)
+
+    def visit(node: ast.AST, held: Tuple[str, ...]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a closure's body runs when *called*, not where defined —
+            # scan it as its own pseudo-method with nothing held
+            _scan_method(scope, f"{method_name}.<locals>.{node.name}", node)
+            return
+        if isinstance(node, ast.With):
+            new_held = held
+            for item in node.items:
+                lk = _lock_ref(item.context_expr, scope)
+                if lk is not None:
+                    if lk in new_held and scope.kind_of(lk) != "RLock":
+                        scope.reacquired.append(
+                            (method_name, item.context_expr.lineno, lk))
+                    for h in new_held:
+                        if h != lk:
+                            scope.edges.setdefault(
+                                (h, lk),
+                                (method_name, item.context_expr.lineno))
+                    scope.direct_acquires[method_name].add(lk)
+                    new_held = new_held + (lk,)
+                else:
+                    visit(item.context_expr, held)
+                if item.optional_vars is not None:
+                    visit(item.optional_vars, held)
+            for st in node.body:
+                visit(st, new_held)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                attr = _target_attr(t, scope.prefix == "self.")
+                if attr is not None and scope.prefix == "self.":
+                    record_write(attr, node.lineno, held)
+                visit(t, held)
+            if getattr(node, "value", None) is not None:
+                visit(node.value, held)
+            return
+        if isinstance(node, ast.Call):
+            if held:
+                blk = _is_blocking(scope, node, held)
+                if blk is not None:
+                    scope.blocking.append(
+                        (method_name, node.lineno, blk, held))
+            name = call_name(node.func)
+            if scope.prefix == "self." and name.startswith("self.") \
+                    and name.count(".") == 1:
+                scope.calls[method_name].append(
+                    (name.split(".", 1)[1], held, node.lineno))
+            elif scope.prefix == "" and name and "." not in name:
+                scope.calls[method_name].append((name, held, node.lineno))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for st in fn.body if isinstance(fn, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) else []:
+        visit(st, ())
+
+
+def _close_over_calls(scope: _ScopeInfo) -> None:
+    """Fixpoint ``may_acquire`` over same-scope calls, adding edges for
+    locks acquired by callees while the caller holds something, and
+    one-call-deep re-entrancy findings."""
+    may: Dict[str, Set[str]] = {m: set(a)
+                                for m, a in scope.direct_acquires.items()}
+    changed = True
+    while changed:
+        changed = False
+        for m, callees in scope.calls.items():
+            for callee, _held, _line in callees:
+                for cand in (callee, f"{m}.<locals>.{callee}"):
+                    if cand in may and not may[cand] <= may[m]:
+                        may[m] |= may[cand]
+                        changed = True
+    for m, callees in scope.calls.items():
+        for callee, held, line in callees:
+            if not held:
+                continue
+            acq = may.get(callee) or may.get(f"{m}.<locals>.{callee}")
+            if not acq:
+                continue
+            for lk in acq:
+                if lk in held:
+                    # one CALL level deep only for the hard-deadlock rule:
+                    # deeper chains get noisy with conditional acquires
+                    direct = scope.direct_acquires.get(callee) or \
+                        scope.direct_acquires.get(
+                            f"{m}.<locals>.{callee}") or set()
+                    if lk in direct and scope.kind_of(lk) != "RLock":
+                        scope.reacquired.append((m, line, lk))
+                else:
+                    for h in held:
+                        scope.edges.setdefault((h, lk), (m, line))
+
+
+def _scope_findings(scope: _ScopeInfo, rel: str) -> List[Finding]:
+    out: List[Finding] = []
+    _close_over_calls(scope)
+
+    reported = set()
+    for (a, b), (meth, line) in sorted(scope.edges.items(),
+                                       key=lambda kv: kv[1][1]):
+        if (b, a) in scope.edges and frozenset((a, b)) not in reported:
+            reported.add(frozenset((a, b)))
+            meth2, line2 = scope.edges[(b, a)]
+            out.append(Finding(
+                "GX-L001", SEV_ERROR, rel, line,
+                symbol=scope.qualname,
+                detail=":".join(sorted((a, b))),
+                message=(f"lock-order inversion in {scope.qualname}: "
+                         f"{meth} takes {b!r} while holding {a!r} "
+                         f"(line {line}) but {meth2} takes {a!r} while "
+                         f"holding {b!r} (line {line2})")))
+
+    for attr, writes in sorted(scope.unguarded_writes.items()):
+        guarded = scope.guarded_writes.get(attr)
+        if not guarded:
+            continue
+        locks = sorted({h for w in guarded for h in w.held})
+        w = writes[0]
+        out.append(Finding(
+            "GX-L002", SEV_WARNING, rel, w.line,
+            symbol=f"{scope.qualname}.{attr}",
+            message=(f"attribute {attr!r} is written under lock(s) "
+                     f"{locks} (e.g. {guarded[0].method}:"
+                     f"{guarded[0].line}) but also written with no lock "
+                     f"held in {w.method}:{w.line}")))
+
+    for meth, line, cname, held in scope.blocking:
+        out.append(Finding(
+            "GX-L003", SEV_WARNING, rel, line,
+            symbol=meth, detail=cname,
+            message=(f"blocking call {cname}() while holding lock(s) "
+                     f"{sorted(set(held))} in {meth}")))
+
+    for meth, line, lk in scope.reacquired:
+        out.append(Finding(
+            "GX-L004", SEV_ERROR, rel, line,
+            symbol=meth, detail=lk,
+            message=(f"{meth} re-acquires non-reentrant lock {lk!r} "
+                     f"already held on this path (use RLock or "
+                     f"restructure) — self-deadlock")))
+    return out
+
+
+def run_concurrency(sources: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in sources:
+        if src.tree is None:
+            continue
+        modname = Path(src.rel).stem
+        # module scope: bare-name locks + module-level functions
+        mod_scope = _ScopeInfo(f"{modname}.<module>", "")
+        _collect_locks(mod_scope, [src.tree], prefix_self=False)
+        for node in src.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _scan_method(mod_scope, node.name, node)
+        if mod_scope.locks:
+            findings += _scope_findings(mod_scope, src.rel)
+        for cls in [n for n in ast.walk(src.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            scope = _ScopeInfo(f"{modname}.{cls.name}", "self.")
+            methods = [n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+            _collect_locks(scope, methods, prefix_self=True)
+            if not scope.locks:
+                continue
+            for m in methods:
+                _scan_method(scope, m.name, m)
+            findings += _scope_findings(scope, src.rel)
+    return findings

@@ -1,0 +1,188 @@
+"""Config-drift pass: env-var surface vs docs vs launch scripts.
+
+The project's entire topology/feature surface is environment variables
+(config.py), documented in docs/env-var-summary.md and exercised by
+scripts/*.sh. These three drift independently; this pass cross-checks.
+
+Rules
+-----
+GX-C201 (error)   knob read by the code (an ``env_*`` registration in
+                  config.py, or a raw ``os.environ`` read anywhere in the
+                  package) that docs/env-var-summary.md does not mention.
+GX-C202 (error)   variable documented in docs/env-var-summary.md that no
+                  code reads any more — a stale doc row.
+GX-C203 (warning) raw ``os.environ``/``os.getenv`` read outside config.py
+                  — bypasses the one place tests/operators can audit.
+GX-C204 (warning) knob-prefixed variable set in scripts/*.sh that the
+                  code never reads — a launch script exporting dead air.
+
+Doc parsing understands the summary table's shorthand: a cell like
+``DMLC_K`` / ``_K_MIN`` or ``...ROOT_URI`` / ``_PORT`` expands the
+leading-underscore form against the previous variable's prefix.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, SEV_ERROR, SEV_WARNING, SourceFile, call_name, \
+    const_str
+
+_ENV_HELPERS = {"env_str", "env_int", "env_float", "env_bool"}
+# prefixes that mark a shell variable as a knob of ours (GX-C204 scope);
+# everything else in a script (PYTHONPATH, loop counters, …) is ignored
+_KNOB_PREFIXES = ("DMLC_", "PS_", "GEOMX_", "MXNET_", "ENABLE_", "DGT_",
+                  "ADAPTIVE_", "MAX_GREED", "UDP_")
+_EXACT_KNOBS = {"PORT"}
+
+_VAR_TOKEN = re.compile(r"`(_?[A-Z][A-Z0-9_]+)`")
+_SH_ASSIGN = re.compile(r"(?:^|[\s;(\"'])(?:export\s+)?"
+                        r"([A-Z][A-Z0-9_]+)=", re.M)
+
+
+def _is_knob(name: str) -> bool:
+    return name in _EXACT_KNOBS or name.startswith(_KNOB_PREFIXES)
+
+
+def _expand_doc_shorthand(tokens: List[str]) -> List[str]:
+    """[`DMLC_PS_GLOBAL_ROOT_URI`, `_PORT`] -> both full names: a
+    leading-underscore token replaces the longest matching tail of the
+    previous full name segment-wise."""
+    out: List[str] = []
+    for tok in tokens:
+        if tok.startswith("_") and out:
+            prev = out[-1]
+            segs = prev.split("_")
+            add = tok.lstrip("_").split("_")
+            # drop as many trailing segments from prev as the shorthand
+            # carries, then append the shorthand
+            base = segs[:-len(add)] if len(add) < len(segs) else segs[:1]
+            out.append("_".join(base + add))
+        else:
+            out.append(tok)
+    return out
+
+
+def parse_doc_vars(doc_path: Path) -> Dict[str, int]:
+    """Documented variable -> first line number."""
+    if not doc_path.exists():
+        return {}
+    vars_: Dict[str, int] = {}
+    for lineno, line in enumerate(
+            doc_path.read_text(encoding="utf-8").splitlines(), 1):
+        if not line.lstrip().startswith("|"):
+            continue
+        tokens = _VAR_TOKEN.findall(line)
+        for name in _expand_doc_shorthand(tokens):
+            vars_.setdefault(name, lineno)
+    return vars_
+
+
+def parse_registrations(config_src: SourceFile) -> Dict[str, int]:
+    """env_*("NAME", ...) registrations in config.py -> line."""
+    regs: Dict[str, int] = {}
+    if config_src.tree is None:
+        return regs
+    for node in ast.walk(config_src.tree):
+        if isinstance(node, ast.Call) \
+                and call_name(node.func) in _ENV_HELPERS and node.args:
+            name = const_str(node.args[0])
+            if name:
+                regs.setdefault(name, node.lineno)
+    return regs
+
+
+def parse_raw_reads(sources: Sequence[SourceFile],
+                    config_rel: str) -> List[Tuple[SourceFile, int, str]]:
+    """(source, line, var) for os.environ.get/os.getenv/os.environ[...]
+    with a constant name, outside config.py."""
+    out = []
+    for src in sources:
+        if src.tree is None or src.rel == config_rel:
+            continue
+        for node in ast.walk(src.tree):
+            name: Optional[str] = None
+            line = 0
+            if isinstance(node, ast.Call):
+                cn = call_name(node.func)
+                if cn in ("os.environ.get", "os.getenv", "environ.get",
+                          "getenv") and node.args:
+                    name = const_str(node.args[0])
+                    line = node.lineno
+            elif isinstance(node, ast.Subscript):
+                if call_name(node.value) in ("os.environ", "environ"):
+                    name = const_str(node.slice)
+                    line = node.lineno
+            if name:
+                out.append((src, line, name))
+    return out
+
+
+def parse_script_vars(script_paths: Sequence[Path],
+                      root: Path) -> Dict[str, Tuple[str, int]]:
+    """Knob-prefixed shell assignments -> (rel path, line)."""
+    vars_: Dict[str, Tuple[str, int]] = {}
+    for sp in script_paths:
+        try:
+            rel = sp.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = sp.as_posix()
+        for lineno, line in enumerate(
+                sp.read_text(encoding="utf-8").splitlines(), 1):
+            for m in _SH_ASSIGN.finditer(line):
+                name = m.group(1)
+                if _is_knob(name):
+                    vars_.setdefault(name, (rel, lineno))
+    return vars_
+
+
+def run_config_drift(sources: Sequence[SourceFile], root: Path,
+                     config_rel: str = "geomx_tpu/config.py",
+                     doc_rel: str = "docs/env-var-summary.md",
+                     scripts_glob: str = "scripts/*.sh") -> List[Finding]:
+    findings: List[Finding] = []
+    config_src = next((s for s in sources if s.rel == config_rel), None)
+    regs = parse_registrations(config_src) if config_src else {}
+    raw = parse_raw_reads(sources, config_rel)
+    doc = parse_doc_vars(root / doc_rel)
+    scripts = parse_script_vars(sorted(root.glob(scripts_glob)), root)
+
+    code_reads: Dict[str, Tuple[str, int]] = {}
+    for name, line in regs.items():
+        code_reads.setdefault(name, (config_rel, line))
+    for src, line, name in raw:
+        code_reads.setdefault(name, (src.rel, line))
+
+    for name, (rel, line) in sorted(code_reads.items()):
+        if name not in doc:
+            findings.append(Finding(
+                "GX-C201", SEV_ERROR, rel, line, symbol=name,
+                message=(f"env knob {name!r} is read by the code but "
+                         f"missing from {doc_rel} — document it or "
+                         f"delete the read")))
+
+    for name, line in sorted(doc.items()):
+        if name not in code_reads and _is_knob(name):
+            findings.append(Finding(
+                "GX-C202", SEV_ERROR, doc_rel, line, symbol=name,
+                message=(f"{doc_rel} documents {name!r} but no code "
+                         f"reads it — stale doc row")))
+
+    for src, line, name in raw:
+        findings.append(Finding(
+            "GX-C203", SEV_WARNING, src.rel, line, symbol=name,
+            message=(f"raw os.environ read of {name!r} outside "
+                     f"config.py — register it through "
+                     f"config.env_str/env_int/env_bool so the knob "
+                     f"surface stays auditable")))
+
+    for name, (rel, line) in sorted(scripts.items()):
+        if name not in code_reads and name not in doc:
+            findings.append(Finding(
+                "GX-C204", SEV_WARNING, rel, line, symbol=name,
+                message=(f"launch script sets {name!r} but no code "
+                         f"reads it — dead knob or typo")))
+    return findings

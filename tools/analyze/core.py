@@ -1,0 +1,166 @@
+"""Shared plumbing for geomx-lint: findings, suppression, baseline.
+
+A finding is (rule, severity, path, line, symbol, message). The baseline
+stores *fingerprints* — ``rule:path:symbol:detail`` — deliberately without
+line numbers, so unrelated edits that shift a file do not invalidate an
+accepted finding. ``symbol`` is the enclosing qualname (``Class.method``,
+``Class.attr``, a variable name, …) and ``detail`` disambiguates multiple
+findings of one rule inside one symbol (the called name, the env var, …).
+
+Suppression: a finding is dropped when its source line, or the line
+directly above it, contains ``geomx-lint: disable=RULE[,RULE...]`` or
+``geomx-lint: disable=all``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+
+_SEV_RANK = {SEV_ERROR: 0, SEV_WARNING: 1}
+
+_DISABLE_RE = re.compile(r"geomx-lint:\s*disable=([A-Za-z0-9_,\-]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str
+    path: str          # repo-relative, posix separators
+    line: int
+    symbol: str        # enclosing qualname / attribute / env-var name
+    message: str
+    detail: str = ""   # extra fingerprint component within one symbol
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}:{self.path}:{self.symbol}:{self.detail}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule} [{self.severity}] "
+                f"{self.message}")
+
+
+def sort_findings(findings: Iterable[Finding]) -> List[Finding]:
+    return sorted(findings,
+                  key=lambda f: (_SEV_RANK.get(f.severity, 9), f.path,
+                                 f.line, f.rule, f.detail))
+
+
+class SourceFile:
+    """One parsed python file: AST + raw lines (for suppression checks)."""
+
+    def __init__(self, path: Path, rel: str, text: str):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(text, filename=str(path))
+        except SyntaxError as e:  # surfaced as a finding, not a crash
+            self.parse_error = e
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(self.lines):
+                m = _DISABLE_RE.search(self.lines[ln - 1])
+                if m:
+                    rules = {r.strip() for r in m.group(1).split(",")}
+                    if "all" in rules or rule in rules:
+                        return True
+        return False
+
+
+def load_sources(paths: Sequence[Path], root: Path) -> List[SourceFile]:
+    """Collect .py files under ``paths`` (files or directories), with
+    repo-relative names computed against ``root``."""
+    out: List[SourceFile] = []
+    seen = set()
+    for p in paths:
+        p = Path(p)
+        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        for f in files:
+            f = f.resolve()
+            if f in seen:
+                continue
+            seen.add(f)
+            try:
+                rel = f.relative_to(root.resolve()).as_posix()
+            except ValueError:
+                rel = f.as_posix()
+            out.append(SourceFile(f, rel, f.read_text(encoding="utf-8")))
+    return out
+
+
+def apply_suppressions(findings: Iterable[Finding],
+                       sources: Sequence[SourceFile]) -> List[Finding]:
+    by_rel: Dict[str, SourceFile] = {s.rel: s for s in sources}
+    kept = []
+    for f in findings:
+        src = by_rel.get(f.path)
+        if src is not None and src.suppressed(f.line, f.rule):
+            continue
+        kept.append(f)
+    return kept
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: Path) -> set:
+    if not path.exists():
+        return set()
+    data = json.loads(path.read_text(encoding="utf-8"))
+    return set(data.get("findings", []))
+
+def save_baseline(path: Path, findings: Iterable[Finding]) -> None:
+    fps = sorted({f.fingerprint for f in findings})
+    path.write_text(
+        json.dumps({"version": 1, "findings": fps}, indent=1) + "\n",
+        encoding="utf-8")
+
+
+def split_by_baseline(findings: Iterable[Finding],
+                      baseline: set) -> Tuple[List[Finding], List[Finding]]:
+    """(new, accepted) partition against a set of fingerprints."""
+    new, accepted = [], []
+    for f in findings:
+        (accepted if f.fingerprint in baseline else new).append(f)
+    return new, accepted
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers shared by the passes
+# ---------------------------------------------------------------------------
+
+def call_name(node: ast.AST) -> str:
+    """Dotted name of a call target: ``jax.jit`` -> "jax.jit",
+    ``self._lock.acquire`` -> "self._lock.acquire"; "" when dynamic."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif isinstance(node, ast.Call):
+        inner = call_name(node.func)
+        parts.append(f"{inner}()" if inner else "()")
+    else:
+        return ""
+    return ".".join(reversed(parts))
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None

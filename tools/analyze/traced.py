@@ -1,0 +1,282 @@
+"""Traced-code pass: hazards in functions reachable from jit/pjit/shard_map.
+
+Rules
+-----
+GX-J101 (error)   implicit host sync inside traced code: ``float()``/
+                  ``int()``/``bool()`` on a traced value, ``np.asarray``/
+                  ``np.array``, ``.item()``/``.tolist()``/``.numpy()``,
+                  ``jax.device_get``. Each forces the tracer to the host —
+                  a ConcretizationTypeError at best, a silent device->host
+                  transfer and pipeline bubble at worst.
+GX-J102 (warning) recompilation hazard: a fresh ``jax.jit(...)`` created
+                  inside a loop, or created-and-immediately-called
+                  (``jax.jit(f)(x)``) — the cache keys on function
+                  identity, so every iteration/call retraces.
+GX-J103 (warning) train-step-shaped jitted function (name contains
+                  ``step``/``update``, returns its own parameter state)
+                  without ``donate_argnums`` — the old parameter buffers
+                  stay live across the update, doubling peak memory.
+
+Reachability: seeds are functions decorated with (or wrapped by a call
+to) ``jax.jit``/``jit``/``pjit``/``jax.shard_map``/``shard_map`` —
+including ``functools.partial(jax.jit, ...)`` forms — closed over
+same-module calls (``f(...)`` to a module/local function, ``self.m(...)``
+to a sibling method). Arguments whose expression involves
+``.shape``/``.ndim``/``.size``/``.dtype``/``len()`` are static under
+tracing and never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, SEV_ERROR, SEV_WARNING, SourceFile, call_name
+
+_JIT_NAMES = {
+    "jax.jit", "jit", "pjit", "jax.pjit", "jax.experimental.pjit.pjit",
+    "jax.shard_map", "shard_map", "jax.experimental.shard_map.shard_map",
+}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+_HOST_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray",
+                    "numpy.array", "onp.asarray", "onp.array",
+                    "jax.device_get", "device_get"}
+_HOST_SYNC_METHODS = (".item", ".tolist", ".numpy", ".block_until_ready")
+_SCALAR_CASTS = {"float", "int", "bool", "complex"}
+_STEP_NAME_RE = re.compile(r"step|update", re.IGNORECASE)
+
+
+def _jit_target(node: ast.Call) -> Tuple[Optional[ast.AST], bool]:
+    """(wrapped-function expr, is_jit_call) for ``jax.jit(f, ...)`` and
+    ``partial(jax.jit, f)`` forms; (None, True) for a jit call whose
+    target is not a simple reference (lambda, call result, …)."""
+    name = call_name(node.func)
+    if name in _JIT_NAMES:
+        return (node.args[0] if node.args else None), True
+    if name in _PARTIAL_NAMES and node.args:
+        if call_name(node.args[0]) in _JIT_NAMES:
+            return (node.args[1] if len(node.args) > 1 else None), True
+    return None, False
+
+
+def _has_donate(node: ast.Call) -> bool:
+    return any(kw.arg and kw.arg.startswith("donate")
+               for kw in node.keywords)
+
+
+def _is_static_expr(node: ast.AST) -> bool:
+    """True when the expression is compile-time static under tracing."""
+    if isinstance(node, ast.Constant):
+        return True
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in (
+                "shape", "ndim", "size", "dtype"):
+            return True
+        if isinstance(sub, ast.Call) and call_name(sub.func) == "len":
+            return True
+    return False
+
+
+class _FnInfo:
+    def __init__(self, node, qualname: str, cls: Optional[str]):
+        self.node = node
+        self.qualname = qualname
+        self.cls = cls
+
+
+def _index_functions(tree: ast.Module) -> List[_FnInfo]:
+    out: List[_FnInfo] = []
+
+    def walk(node: ast.AST, prefix: str, cls: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                out.append(_FnInfo(child, q, cls))
+                walk(child, f"{q}.<locals>.", cls)
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}{child.name}.", child.name)
+            else:
+                walk(child, prefix, cls)
+
+    walk(tree, "", None)
+    return out
+
+
+def run_traced(sources: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in sources:
+        if src.tree is None:
+            continue
+        fns = _index_functions(src.tree)
+        by_name: Dict[str, List[_FnInfo]] = {}
+        for fi in fns:
+            by_name.setdefault(fi.node.name, []).append(fi)
+        node_to_info = {fi.node: fi for fi in fns}
+
+        # ---- seeds: decorated or wrapped by jit-ish callables --------
+        seeds: Set[ast.AST] = set()
+        jit_wraps: List[Tuple[_FnInfo, Optional[ast.Call]]] = []
+
+        def resolve(expr: ast.AST, near: Optional[_FnInfo]) -> \
+                Optional[_FnInfo]:
+            nm = call_name(expr)
+            if not nm:
+                return None
+            if nm.startswith("self.") and nm.count(".") == 1 and near:
+                nm = nm.split(".", 1)[1]
+                cands = [f for f in by_name.get(nm, [])
+                         if f.cls and f.cls == near.cls]
+                return cands[0] if cands else None
+            if "." in nm:
+                return None
+            cands = by_name.get(nm, [])
+            return cands[0] if cands else None
+
+        for fi in fns:
+            node = fi.node
+            for dec in node.decorator_list:
+                if call_name(dec) in _JIT_NAMES:
+                    seeds.add(node)
+                    jit_wraps.append((fi, None))
+                elif isinstance(dec, ast.Call):
+                    tgt, is_jit = _jit_target(dec)
+                    if is_jit or call_name(dec.func) in _JIT_NAMES:
+                        seeds.add(node)
+                        jit_wraps.append((fi, dec))
+
+        # enclosing function of every AST node (for loop/wrap context)
+        encl: Dict[ast.AST, Optional[_FnInfo]] = {}
+
+        def mark(node: ast.AST, cur: Optional[_FnInfo]):
+            encl[node] = cur
+            nxt = node_to_info.get(node, cur)
+            for child in ast.iter_child_nodes(node):
+                mark(child, nxt)
+
+        mark(src.tree, None)
+
+        loop_depth: Dict[ast.AST, int] = {}
+
+        def mark_loops(node: ast.AST, depth: int):
+            loop_depth[node] = depth
+            d = depth + 1 if isinstance(node, (ast.For, ast.While)) else depth
+            for child in ast.iter_child_nodes(node):
+                mark_loops(child, d)
+
+        mark_loops(src.tree, 0)
+
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tgt, is_jit = _jit_target(node)
+            if not is_jit:
+                continue
+            near = encl.get(node)
+            target = resolve(tgt, near) if tgt is not None else None
+            if target is not None:
+                seeds.add(target.node)
+                jit_wraps.append((target, node))
+            if loop_depth.get(node, 0) > 0:
+                findings.append(Finding(
+                    "GX-J102", SEV_WARNING, src.rel, node.lineno,
+                    symbol=near.qualname if near else "<module>",
+                    detail=f"loop:{call_name(node.func)}",
+                    message=("jit/shard_map constructed inside a loop — "
+                             "the trace cache keys on function identity, "
+                             "so each iteration retraces; hoist the "
+                             "wrapped function out of the loop")))
+
+        # jit(f)(x): the wrapper is born and dies per call — retrace
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call) and isinstance(node.func,
+                                                         ast.Call):
+                _tgt, is_jit = _jit_target(node.func)
+                if is_jit:
+                    near = encl.get(node)
+                    findings.append(Finding(
+                        "GX-J102", SEV_WARNING, src.rel, node.lineno,
+                        symbol=near.qualname if near else "<module>",
+                        detail="inline-call",
+                        message=("jax.jit(...) created and immediately "
+                                 "called — a fresh wrapper per call "
+                                 "means a retrace per call; bind the "
+                                 "jitted function once and reuse it")))
+
+        # ---- close reachability over same-module calls ---------------
+        traced: Set[ast.AST] = set(seeds)
+        frontier = list(seeds)
+        while frontier:
+            fn = frontier.pop()
+            fi = node_to_info[fn]
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call):
+                    callee = resolve(sub.func, fi)
+                    if callee is not None and callee.node not in traced:
+                        traced.add(callee.node)
+                        frontier.append(callee.node)
+
+        # ---- GX-J101 host syncs in traced bodies ---------------------
+        for fn in traced:
+            fi = node_to_info[fn]
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                nm = call_name(sub.func)
+                hit = None
+                if nm in _SCALAR_CASTS and sub.args \
+                        and not _is_static_expr(sub.args[0]):
+                    hit = nm
+                elif nm in _HOST_SYNC_CALLS:
+                    hit = nm
+                elif nm.endswith(_HOST_SYNC_METHODS):
+                    hit = nm
+                if hit is not None:
+                    findings.append(Finding(
+                        "GX-J101", SEV_ERROR, src.rel, sub.lineno,
+                        symbol=fi.qualname, detail=f"{hit}:{sub.lineno}",
+                        message=(f"{hit}() inside jit-traced "
+                                 f"{fi.qualname} forces a host sync "
+                                 f"(ConcretizationTypeError or silent "
+                                 f"device->host transfer)")))
+
+        # ---- GX-J103 donate_argnums on train-step shapes -------------
+        seen_j103: Set[str] = set()
+        for fi, wrap in jit_wraps:
+            if not _STEP_NAME_RE.search(fi.node.name):
+                continue
+            if wrap is not None and _has_donate(wrap):
+                continue
+            if wrap is None and any(
+                    isinstance(d, ast.Call) and _has_donate(d)
+                    for d in fi.node.decorator_list):
+                continue
+            params = [a.arg for a in fi.node.args.args
+                      if a.arg not in ("self", "cls")]
+            if not params:
+                continue
+            state_params = set(params[:2])
+            returns_state = False
+            for sub in ast.walk(fi.node):
+                if isinstance(sub, ast.Return) and sub.value is not None:
+                    elts = sub.value.elts if isinstance(sub.value,
+                                                       ast.Tuple) \
+                        else [sub.value]
+                    # only a param returned as a DIRECT tuple element is
+                    # pass-through state worth donating; a param merely
+                    # referenced inside the return expression is an input
+                    # the caller still owns
+                    for n in elts:
+                        if isinstance(n, ast.Name) and n.id in state_params:
+                            returns_state = True
+            if not returns_state or fi.qualname in seen_j103:
+                continue
+            seen_j103.add(fi.qualname)
+            findings.append(Finding(
+                "GX-J103", SEV_WARNING, src.rel, fi.node.lineno,
+                symbol=fi.qualname,
+                message=(f"jitted train-step {fi.qualname} returns its "
+                         f"parameter state but donates nothing — pass "
+                         f"donate_argnums for the state args so XLA can "
+                         f"reuse the old buffers in place")))
+    return findings
