@@ -388,6 +388,7 @@ def bench_hips_bsc(threshold: float = 0.02, lr: float = 0.05,
         leaves0, _td, grad_step, eval_step = build_model_and_step(bs)
         rounds = [0, 0]
         accs = [0.0, 0.0]
+        phases = [None, None]
         stop_round = [None]
         phase_b = threading.Event()
         phase_a_done = [False, False]
@@ -418,6 +419,20 @@ def bench_hips_bsc(threshold: float = 0.02, lr: float = 0.05,
                 X, y = batches[it % len(batches)]
                 tr.step(X, y)
             accs[widx] = eval_acc(test_iter, tr.leaves, eval_step)
+            # per-phase round breakdown (compute/d2h/wire/h2d/apply),
+            # value-fetch fenced per PERF.md round-5 honesty rules.
+            # Runs HERE — after the accuracy eval, before the
+            # throughput gate — because step_timed's fences would
+            # deflate img/s if they ran during trials. Both workers
+            # step (FSA rounds need everyone); worker 0's medians are
+            # reported.
+            timed = []
+            for j in range(5):
+                X, y = batches[j % len(batches)]
+                _loss, ph = tr.step_timed(X, y)
+                timed.append(ph)
+            phases[widx] = {k: round(statistics.median(
+                [p[k] for p in timed]), 2) for k in timed[0]}
             phase_a_done[widx] = True
             if all(phase_a_done):
                 phase_b.set()
@@ -442,6 +457,7 @@ def bench_hips_bsc(threshold: float = 0.02, lr: float = 0.05,
         return {"img_s": statistics.median(per_trial),
                 "acc": float(min(accs)),
                 "threshold": threshold,
+                "phases": phases[0],
                 "trials": [round(x, 1) for x in per_trial]}
     finally:
         topo.stop()
@@ -960,6 +976,8 @@ def _assemble(data: dict):
             "img_s": round(bsc["img_s"], 1),
             f"acc_at_{BSC_ACC_ITERS}_iters": round(bsc["acc"], 4),
             "threshold": bsc["threshold"], "trials": bsc["trials"]}
+        if bsc.get("phases"):
+            details["hips_bsc_cnn"]["round_phases_ms"] = bsc["phases"]
     else:
         details["hips_bsc_cnn"] = bsc or {"error": "not run"}
     parity_failures = []

@@ -187,6 +187,19 @@ class Config:
     barrier_timeout_s: float = 600.0    # PS_BARRIER_TIMEOUT
     op_timeout_s: float = 300.0         # PS_OP_TIMEOUT (push/pull/wait)
 
+    # ---- pipelined round (ours; PERF.md "pipelined round") ----
+    # P3 chunk budget in BYTES for the async chunked combined wire
+    # (KVStoreDist.push_pull_async / push_pull_bsc_batch_async): the key
+    # set is greedily grouped in layer order into ~this many bytes per
+    # chunk — and dense keys above it are sliced at _shards granularity —
+    # each chunk one message per server, flowing independently at
+    # descending priority. 0 = one chunk (the round-5 batched wire).
+    p3_slice_bytes: int = 0             # P3_SLICE_BYTES
+    # trainer-side overlap switch: per-chunk dispatch/apply in
+    # DeviceResidentTrainer and the deferred round barrier in Trainer
+    # (the barrier moves to the point of first use, not away)
+    overlap: bool = True                # GEOMX_OVERLAP
+
     # ---- TPU-specific ----
     van_type: str = "auto"              # GEOMX_VAN in {auto, python, native}
     platform: str = ""                  # GEOMX_PLATFORM override for jax
@@ -273,6 +286,8 @@ def load() -> Config:
         verbose=env_int("PS_VERBOSE", 0),
         barrier_timeout_s=env_float("PS_BARRIER_TIMEOUT", 600.0),
         op_timeout_s=env_float("PS_OP_TIMEOUT", 300.0),
+        p3_slice_bytes=env_int("P3_SLICE_BYTES", 0),
+        overlap=env_bool("GEOMX_OVERLAP", True),
         van_type=env_str("GEOMX_VAN", "auto"),
         platform=env_str("GEOMX_PLATFORM"),
     )

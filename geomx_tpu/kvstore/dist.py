@@ -36,8 +36,10 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from geomx_tpu import config as cfg_mod
+from geomx_tpu import profiler
 from geomx_tpu.kvstore import sharding
 from geomx_tpu.kvstore.base import Command, DATA_INIT, KVStore, _sum_values
+from geomx_tpu.kvstore.frontier import RoundFuture, plan_chunks
 from geomx_tpu.ps import base as psbase
 from geomx_tpu.ps.kv_app import KVPairs, KVWorker
 from geomx_tpu.ps.message import Role
@@ -163,6 +165,15 @@ class KVStoreDist(KVStore):
             # send thread can interleave layers (kvstore_dist.h:768-805)
             return sharding.assign_p3(key, total, self.po.num_servers,
                                       self.cfg.bigarray_bound)
+        if self.cfg.p3_slice_bytes > 0:
+            # pipelined round: slice big keys at the chunk budget so
+            # push_pull_async can put each slice in its own chunk — shard
+            # boundaries must be fixed at init (the server FSA registers
+            # per-(key, offset) states on first contact), so the budget
+            # feeds the slicer here, not per call
+            return sharding.assign_p3(
+                key, total, self.po.num_servers,
+                max(1, self.cfg.p3_slice_bytes // 4))
         return sharding.assign(key, total, self.po.num_servers,
                                self.cfg.bigarray_bound)
 
@@ -480,6 +491,171 @@ class KVStoreDist(KVStore):
             self.kvw.push(kvs, srank, priority=priority, pull=True,
                           cb=lambda ts, s=srank: on_resp(ts, s))
 
+    def _consume_errors(self, errs: List[str]) -> None:
+        """RoundFuture consume hook: the future raised these give-ups,
+        so remove them from the global list a later wait() would drain
+        (errors surface exactly once — the BSC join contract)."""
+        with self._lock:
+            self._transport_errors = [
+                e for e in self._transport_errors if e not in errs]
+
+    def push_pull_async(self, key, value, out, priority: int = 0,
+                        slice_bytes: Optional[int] = None) -> RoundFuture:
+        """Non-blocking chunked combined round (the P3-pipelined form of
+        :meth:`push_pull`): the (key, shard) entry list — layer order
+        preserved — splits into ~``slice_bytes``-byte chunks (default
+        ``cfg.p3_slice_bytes``; <= 0 means one chunk), each chunk ONE
+        message per server at descending priority, every chunk's send
+        and response flowing independently. Returns a
+        :class:`RoundFuture`: each key's ``out`` array holds the
+        post-round state when the future completes that key, so the
+        caller can apply key i while key j's bytes are still on the
+        wire. Give-ups surface through ``fut.wait()`` with the same
+        class mapping as :meth:`wait`.
+
+        Big keys chunk at ``_shards`` granularity — set ``P3_SLICE_BYTES``
+        before init so the slicer feeds the shard map (the server FSA
+        pins per-(key, offset) states at first contact). Not available
+        on TSEngine overlays (models disseminate out-of-band)."""
+        if self._ts is not None:
+            raise NotImplementedError(
+                "push_pull_async is not supported on TSEngine overlays")
+        keys = self._as_key_list(key)
+        values = value if isinstance(value, (list, tuple)) \
+            and len(keys) > 1 else [value]
+        outs = out if isinstance(out, (list, tuple)) and len(keys) > 1 \
+            else [out]
+        if len(set(keys)) != len(keys):
+            raise ValueError("push_pull_async: duplicate keys in one round")
+        for o in outs:
+            if not (isinstance(o, np.ndarray) and o.flags.writeable):
+                raise TypeError(
+                    "push_pull_async requires writable numpy ndarrays")
+        sb = self.cfg.p3_slice_bytes if slice_bytes is None else slice_bytes
+        # layer-ordered (key, shard, flat-segment) entry list
+        entries = []
+        for k, v in zip(keys, values):
+            merged = _sum_values(v)
+            info = self._info(k, merged)
+            flat = np.ascontiguousarray(merged).ravel()
+            for sh in info.shards:
+                entries.append(
+                    (k, sh, flat[sh.offset:sh.offset + sh.length]))
+        chunks = plan_chunks(list(range(len(entries))),
+                             [e[2].nbytes for e in entries],
+                             sb, base_priority=priority)
+        fut = RoundFuture(keys, consume=self._consume_errors)
+        bufs = {k: np.zeros(self._key_info[k].total, np.float32)
+                for k in keys}
+        out_of = dict(zip(keys, outs))
+        # one message per (chunk, server); a key completes when every
+        # message carrying one of its entries has responded with data
+        msgs = []  # (mid, cid, srank, kvs, msg_keys, chunk_priority)
+        key_msgs: Dict[int, List[int]] = {k: [] for k in keys}
+        for ch in chunks:
+            per_server: Dict[int, KVPairs] = {}
+            server_keys: Dict[int, List[int]] = {}
+            for ei in ch.items:
+                k, sh, seg = entries[ei]
+                kvs = per_server.setdefault(sh.server_rank, KVPairs())
+                kvs.keys.append(k)
+                kvs.vals.append(seg)
+                kvs.offsets.append(sh.offset)
+                kvs.totals.append(sh.total)
+                kvs.lens.append(sh.length)
+                server_keys.setdefault(sh.server_rank, []).append(k)
+            for srank, kvs in per_server.items():
+                mid = len(msgs)
+                for k in set(server_keys[srank]):
+                    key_msgs[k].append(mid)
+                msgs.append((mid, ch.cid, srank, kvs,
+                             server_keys[srank], ch.priority))
+        msgs_left = {k: len(key_msgs[k]) for k in keys}
+        with self._lock:
+            for _mid, _cid, _srank, _kvs, mks, _p in msgs:
+                for k in mks:
+                    self._push_acks_left[k] = (
+                        self._push_acks_left.get(k, 0) + 1)
+        for _mid, _cid, _srank, _kvs, mks, _p in msgs:
+            for k in mks:
+                self._track(1, k)
+
+        got_data: set = set()
+
+        def on_resp(ts: int, mid: int):
+            _m, cid, srank, _kvs, mks, _p = msgs[mid]
+            fail = self.kvw.take_failure(ts)
+            failed_keys = []
+            if fail is not None:
+                with self._lock:
+                    for k in sorted(set(mks)):
+                        err = f"push_pull_async key {k}: {fail}"
+                        self._transport_errors.append(err)
+                        failed_keys.append((k, err))
+            for k, err in failed_keys:
+                fut.add_error(k, err)   # future methods outside _lock
+            finished = []
+            with profiler.chunk_scope("recv", cid, server=srank):
+                for kvs in self.kvw.take_response(ts):
+                    for i, k in enumerate(kvs.keys):
+                        data = np.asarray(kvs.vals[i]).ravel().astype(
+                            np.float32)
+                        r_off = kvs.offset_of(i)
+                        buf = bufs[k]
+                        n = min(data.size, buf.size - r_off)
+                        buf[r_off:r_off + n] = data[:n]
+                        with self._lock:
+                            got_data.add((k, mid))
+            with self._lock:
+                for k in set(mks):
+                    msgs_left[k] -= 1
+                    if msgs_left[k] == 0:
+                        finished.append(k)
+            fallback = []
+            completed = []
+            for k in finished:
+                with self._lock:
+                    complete = all((k, m) in got_data
+                                   for m in key_msgs[k])
+                if complete:
+                    info = self._key_info[k]
+                    np.copyto(out_of[k], bufs[k].reshape(info.shape)
+                              .astype(info.dtype, copy=False))
+                    completed.append(k)
+                elif fut.errors(k):
+                    # data is never coming (transport gave up): complete
+                    # so joins raise the error instead of timing out
+                    completed.append(k)
+                else:
+                    # a server acked without data — same no-zero-copyback
+                    # rule as push_pull: explicit async re-pull, future
+                    # completes when the out array holds real data
+                    fallback.append(k)
+            if fallback:
+                self._pull_batch(fallback,
+                                 [out_of[k] for k in fallback], priority,
+                                 on_key=fut.complete_key)
+            ready = []
+            with self._lock:
+                for k in mks:
+                    self._push_acks_left[k] -= 1
+                    if (self._push_acks_left[k] == 0
+                            and k in self._deferred):
+                        ready.extend(self._deferred.pop(k))
+            for k in mks:
+                self._untrack(k)
+            for fn in ready:
+                fn()
+            for k in completed:
+                fut.complete_key(k)
+
+        for mid, cid, srank, kvs, _mks, prio in msgs:
+            with profiler.chunk_scope("send", cid, server=srank,
+                                      keys=len(kvs.keys)):
+                self.kvw.push(kvs, srank, priority=prio, pull=True,
+                              cb=lambda ts, m=mid: on_resp(ts, m))
+        return fut
+
     def pull(self, key, out=None, priority: int = 0):
         """Async pull into ``out`` (ordered after this key's push acks);
         blocking when ``out`` is None. Use wait()/waitall to join.
@@ -509,7 +685,8 @@ class KVStoreDist(KVStore):
             return results[0] if len(results) == 1 else results
         return None
 
-    def _pull_batch(self, keys: List[int], outs: List, priority: int
+    def _pull_batch(self, keys: List[int], outs: List, priority: int,
+                    on_key: Optional[Callable[[int], None]] = None
                     ) -> None:
         for k, o in zip(keys, outs):
             assert self._key_info.get(k) is not None, \
@@ -569,6 +746,10 @@ class KVStoreDist(KVStore):
                 np.copyto(out_of[k], bufs[k].reshape(info.shape)
                           .astype(info.dtype, copy=False))
                 self._untrack(k)
+                if on_key is not None:
+                    # async completion hook (push_pull_async fallback
+                    # path): fires AFTER the out array holds the data
+                    on_key(k)
 
         for srank, kvs in per_server.items():
             def issue(sr=srank, kv=kvs):
@@ -1053,6 +1234,213 @@ class KVStoreDist(KVStore):
             return out
 
         return join
+
+    def push_pull_bsc_batch_async(self, keys, values_list, indices_list,
+                                  priority: int = 0,
+                                  slice_bytes: Optional[int] = None
+                                  ) -> RoundFuture:
+        """Non-blocking chunked combined sparse round (the P3-pipelined
+        form of :meth:`push_pull_bsc_batch`): keys group in layer order
+        into ~``slice_bytes``-byte chunks (~8 wire bytes per selected
+        element; default ``cfg.p3_slice_bytes``, <= 0 = one chunk), one
+        message per (chunk, server) at descending priority. Keys stay
+        WHOLE — the server FSA counts one push per (key, shard) per
+        worker per round, so intra-key splitting would double-count.
+        Returns a :class:`RoundFuture` whose per-key result is
+        ``(values float32, flat_indices int64)``, completing each key as
+        its last response lands — apply key i while key j is still on
+        the wire. Give-ups surface through ``fut.wait()``."""
+        assert len(set(keys)) == len(keys), "duplicate keys in one round"
+        keys = list(keys)
+        sb = self.cfg.p3_slice_bytes if slice_bytes is None else slice_bytes
+        sizes = [np.asarray(v).size * 8 for v in values_list]
+        chunks = plan_chunks(list(range(len(keys))), sizes, sb,
+                             base_priority=priority)
+        fut = RoundFuture(keys, consume=self._consume_errors)
+        parts: Dict[int, List] = {k: [] for k in keys}
+        expected_parts: Dict[int, int] = {}
+        msgs = []  # (mid, cid, srank, kvs, msg_keys, chunk_priority)
+        key_msgs: Dict[int, List[int]] = {k: [] for k in keys}
+        for ch in chunks:
+            cks = [keys[i] for i in ch.items]
+            per_server, server_keys = self._prepare_bsc_shards(
+                cks, [values_list[i] for i in ch.items],
+                [indices_list[i] for i in ch.items])
+            for srank, kvs in per_server.items():
+                mid = len(msgs)
+                for k in set(server_keys[srank]):
+                    key_msgs[k].append(mid)
+                for k in server_keys[srank]:
+                    expected_parts[k] = expected_parts.get(k, 0) + 1
+                msgs.append((mid, ch.cid, srank, kvs,
+                             server_keys[srank], ch.priority))
+        msgs_left = {k: len(key_msgs[k]) for k in keys}
+        with self._lock:
+            for _mid, _cid, _srank, _kvs, mks, _p in msgs:
+                for k in mks:
+                    self._push_acks_left[k] = (
+                        self._push_acks_left.get(k, 0) + 1)
+        for _mid, _cid, _srank, _kvs, mks, _p in msgs:
+            for k in mks:
+                self._track(1, k)
+
+        def on_resp(ts: int, mid: int):
+            _m, cid, srank, _kvs, mks, _p = msgs[mid]
+            fail = self.kvw.take_failure(ts)
+            failed_keys = []
+            if fail is not None:
+                with self._lock:
+                    for k in sorted(set(mks)):
+                        err = f"push_pull_bsc_async key {k}: {fail}"
+                        self._transport_errors.append(err)
+                        failed_keys.append((k, err))
+            for k, err in failed_keys:
+                fut.add_error(k, err)   # future methods outside _lock
+            with profiler.chunk_scope("recv", cid, server=srank):
+                for kvs in self.kvw.take_response(ts):
+                    for i, k in enumerate(kvs.keys):
+                        data = np.asarray(kvs.vals[i],
+                                          dtype=np.float32).ravel()
+                        r_off = kvs.offset_of(i)
+                        aux = kvs.aux[i] if i < len(kvs.aux) else None
+                        if kvs.compr == "bsc" and aux is not None:
+                            entry = (data,
+                                     np.asarray(aux, np.int64).ravel()
+                                     + r_off)
+                        else:
+                            nz = np.nonzero(data)[0]
+                            entry = (data[nz].astype(np.float32),
+                                     nz + r_off)
+                        with self._lock:
+                            parts[k].append(entry)
+            finished = []
+            ready = []
+            with self._lock:
+                for k in set(mks):
+                    msgs_left[k] -= 1
+                    if msgs_left[k] == 0:
+                        finished.append(k)
+                for k in mks:
+                    self._push_acks_left[k] -= 1
+                    if (self._push_acks_left[k] == 0
+                            and k in self._deferred):
+                        ready.extend(self._deferred.pop(k))
+            for k in mks:
+                self._untrack(k)
+            for fn in ready:
+                fn()
+            short = []
+            for k in finished:
+                with self._lock:
+                    ps = list(parts[k])
+                if fut.errors(k):
+                    # data is never coming: complete so joins raise
+                    fut.complete_key(k, (np.zeros(0, np.float32),
+                                         np.zeros(0, np.int64)))
+                elif len(ps) < expected_parts[k]:
+                    # a server acked without data — a missing entry is
+                    # NOT an empty aggregate; async re-pull (this runs
+                    # on a transport thread: never block here)
+                    short.append(k)
+                elif not ps:
+                    fut.complete_key(k, (np.zeros(0, np.float32),
+                                         np.zeros(0, np.int64)))
+                else:
+                    fut.complete_key(
+                        k, (np.concatenate([p[0] for p in ps]),
+                            np.concatenate([p[1] for p in ps])))
+            if short:
+                self._repull_bsc_async(short, priority, fut)
+
+        for mid, cid, srank, kvs, _mks, prio in msgs:
+            with profiler.chunk_scope("send", cid, server=srank,
+                                      keys=len(kvs.keys)):
+                self.kvw.push(kvs, srank, priority=prio, pull=True,
+                              cb=lambda ts, m=mid: on_resp(ts, m))
+        return fut
+
+    def _repull_bsc_async(self, keys, priority: int,
+                          fut: RoundFuture) -> None:
+        """Async fallback pull for BSC keys whose combined ack came back
+        short: per-server "bsc" pulls, completing each key on ``fut`` as
+        its last response lands (the non-blocking twin of the
+        pull_bsc_batch re-pull in push_pull_bsc_batch's join)."""
+        per_server: Dict[int, KVPairs] = {}
+        server_keys: Dict[int, List[int]] = {}
+        for k in keys:
+            info = self._key_info[k]
+            for sh in info.shards:
+                kvs = per_server.setdefault(sh.server_rank,
+                                            KVPairs(compr="bsc"))
+                kvs.keys.append(k)
+                kvs.vals.append(np.zeros(0, np.float32))
+                kvs.offsets.append(sh.offset)
+                kvs.totals.append(sh.total)
+                kvs.lens.append(sh.length)
+                server_keys.setdefault(sh.server_rank, []).append(k)
+        parts: Dict[int, List] = {k: [] for k in keys}
+        msgs_left: Dict[int, int] = {}
+        with self._lock:
+            for srank, ks in server_keys.items():
+                for k in set(ks):
+                    msgs_left[k] = msgs_left.get(k, 0) + 1
+        for ks in server_keys.values():
+            for k in ks:
+                self._track(1, k)
+
+        def on_data(ts: int, srank: int):
+            fail = self.kvw.take_failure(ts)
+            failed_keys = []
+            if fail is not None:
+                with self._lock:
+                    for k in sorted(set(server_keys[srank])):
+                        err = f"pull_bsc key {k}: {fail}"
+                        self._transport_errors.append(err)
+                        failed_keys.append((k, err))
+            for k, err in failed_keys:
+                fut.add_error(k, err)
+            for kvs in self.kvw.take_response(ts):
+                for i, k in enumerate(kvs.keys):
+                    data = np.asarray(kvs.vals[i],
+                                      dtype=np.float32).ravel()
+                    r_off = kvs.offset_of(i)
+                    aux = kvs.aux[i] if i < len(kvs.aux) else None
+                    if kvs.compr == "bsc" and aux is not None:
+                        entry = (data,
+                                 np.asarray(aux, np.int64).ravel()
+                                 + r_off)
+                    else:
+                        nz = np.nonzero(data)[0]
+                        entry = (data[nz].astype(np.float32), nz + r_off)
+                    with self._lock:
+                        parts[k].append(entry)
+            finished = []
+            with self._lock:
+                for k in set(server_keys[srank]):
+                    msgs_left[k] -= 1
+                    if msgs_left[k] == 0:
+                        finished.append(k)
+            for k in server_keys[srank]:
+                self._untrack(k)
+            for k in finished:
+                with self._lock:
+                    ps = list(parts[k])
+                if not ps:
+                    fut.complete_key(k, (np.zeros(0, np.float32),
+                                         np.zeros(0, np.int64)))
+                else:
+                    fut.complete_key(
+                        k, (np.concatenate([p[0] for p in ps]),
+                            np.concatenate([p[1] for p in ps])))
+
+        for srank, kvs in per_server.items():
+            def issue(sr=srank, kv=kvs):
+                self.kvw.pull(kv.keys, sr, offsets=kv.offsets,
+                              totals=kv.totals, lens=kv.lens,
+                              priority=priority, compr="bsc",
+                              cb=lambda ts, s=sr: on_data(ts, s))
+
+            self._issue_after_push_acks(set(server_keys[srank]), issue)
 
     def pull_bsc_batch(self, keys, priority: int = 0,
                        timeout: float = None):

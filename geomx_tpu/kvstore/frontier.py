@@ -1,0 +1,198 @@
+"""Async round frontier: per-key futures + P3 chunk planning.
+
+The round-5 combined wire (ZPushPull, one message per server per round)
+made the protocol cheap but left it a single barrier: the trainer
+dispatches everything, then blocks in ``wait()`` until the last byte of
+the last key is back. P3 (priority-based parameter propagation with
+tensor slicing — reference: P3_EncodeDefaultKey, kvstore_dist.h:768-805
++ the priority send thread, van.cc:548,851) exists precisely to break
+that barrier: split the round into priority-ordered chunks so each
+chunk's D2H fetch, wire send, and response flow independently, and let
+the caller consume results per chunk as they land.
+
+This module holds the two store-agnostic pieces:
+
+- :func:`plan_chunks` — greedy layer-order grouping of sized items into
+  ~budget-byte chunks, chunk index descending into priority (layer
+  order = priority, the P3 scheduling rule: earlier layers' chunks are
+  needed sooner on the next forward);
+- :class:`RoundFuture` — the non-blocking handle for one communication
+  round with PER-KEY completion. Transport callbacks complete keys
+  (result or give-up error); callers join with ``wait()`` /
+  ``result(key)`` / ``results()``, or chain work with ``on_key``.
+  PR-1 give-up errors propagate through the future with the same
+  class mapping as ``KVStoreDist.wait()`` (a blown PS_RESEND_DEADLINE
+  is a TimeoutError, retry-cap give-ups stay RuntimeError), and are
+  consumed from the store's global error list so they raise exactly
+  once.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+__all__ = ["give_up_exc", "Chunk", "plan_chunks", "RoundFuture"]
+
+
+def give_up_exc(errs: Iterable[str]) -> type:
+    """Exception class for surfacing transport give-ups: a blown
+    PS_RESEND_DEADLINE (the resender tags it "delivery deadline") is a
+    TimeoutError at the issuing customer; retry-cap give-ups stay
+    RuntimeError. Callback-driven ops only see the reason STRING
+    (Customer.on_fail), so the class is recovered from it here."""
+    return (TimeoutError
+            if any("delivery deadline" in e for e in errs)
+            else RuntimeError)
+
+
+class Chunk:
+    """One priority-ordered slice of a round: ``items`` is a subset of
+    the caller's entries (keys, or (key, shard) indices) in layer
+    order; ``priority`` already encodes the P3 rule (chunk i of a
+    round at base priority p sends at p - i)."""
+
+    __slots__ = ("cid", "items", "priority")
+
+    def __init__(self, cid: int, items: List, priority: int):
+        self.cid = cid
+        self.items = items
+        self.priority = priority
+
+    def __repr__(self) -> str:  # debugging/test aid
+        return f"Chunk(cid={self.cid}, items={self.items}, " \
+               f"priority={self.priority})"
+
+
+def plan_chunks(items: Sequence, sizes_bytes: Sequence[int],
+                budget_bytes: int, base_priority: int = 0) -> List[Chunk]:
+    """Greedily group ``items`` (layer order preserved) into chunks of
+    at most ~``budget_bytes`` each; an item larger than the budget gets
+    a chunk of its own rather than being split (splitting is the
+    caller's job — dense keys split at ``_shards`` granularity, BSC
+    keys must stay whole because the server FSA counts one push per
+    (key, shard) per worker per round). ``budget_bytes <= 0`` means one
+    chunk holding everything (the round-5 batched wire)."""
+    assert len(items) == len(sizes_bytes)
+    if not items:
+        return []
+    if budget_bytes <= 0:
+        return [Chunk(0, list(items), base_priority)]
+    chunks: List[Chunk] = []
+    cur: List = []
+    cur_bytes = 0
+    for it, sz in zip(items, sizes_bytes):
+        if cur and cur_bytes + sz > budget_bytes:
+            chunks.append(Chunk(len(chunks), cur,
+                                base_priority - len(chunks)))
+            cur, cur_bytes = [], 0
+        cur.append(it)
+        cur_bytes += sz
+    if cur:
+        chunks.append(Chunk(len(chunks), cur, base_priority - len(chunks)))
+    return chunks
+
+
+class RoundFuture:
+    """Per-key completion handle for one communication round.
+
+    The issuing store registers the round's keys up front; transport
+    callbacks then call :meth:`complete_key` (and :meth:`add_error` for
+    give-ups) as responses land, in any order. ``consume`` — installed
+    by the issuing store — removes this round's error strings from the
+    store's global ``wait()`` list when the future raises them, so an
+    error surfaces exactly once (the join-consumes-its-own-failures
+    contract of the PR-r5 BSC joins)."""
+
+    def __init__(self, keys: Iterable[int],
+                 consume: Optional[Callable[[List[str]], None]] = None):
+        self._cv = threading.Condition()
+        self._keys: List[int] = list(keys)
+        self._pending = set(self._keys)
+        assert len(self._pending) == len(self._keys), \
+            "RoundFuture: duplicate keys in one round"
+        self._results: Dict[int, object] = {}
+        self._errors: Dict[int, List[str]] = {}
+        self._callbacks: Dict[int, List[Callable[[int], None]]] = {}
+        self._consume = consume
+
+    @property
+    def keys(self) -> List[int]:
+        return list(self._keys)
+
+    # -- completion (transport-callback side) -----------------------------
+
+    def add_error(self, key: int, err: str) -> None:
+        """Record a transport give-up for ``key`` without completing it
+        (its other messages may still be in flight); raised by the
+        first join that covers the key."""
+        with self._cv:
+            self._errors.setdefault(key, []).append(err)
+
+    def complete_key(self, key: int, result=None) -> None:
+        """Mark ``key`` done (idempotent) with its result; fires any
+        ``on_key`` continuations OUTSIDE the future's lock."""
+        with self._cv:
+            if key not in self._pending:
+                return
+            self._pending.discard(key)
+            self._results[key] = result
+            cbs = self._callbacks.pop(key, [])
+            self._cv.notify_all()
+        for fn in cbs:
+            fn(key)
+
+    # -- joining (caller side) --------------------------------------------
+
+    def done(self, keys: Optional[Iterable[int]] = None) -> bool:
+        klist = self._keys if keys is None else list(keys)
+        with self._cv:
+            return all(k not in self._pending for k in klist)
+
+    def errors(self, key: int) -> List[str]:
+        with self._cv:
+            return list(self._errors.get(key, []))
+
+    def on_key(self, key: int, fn: Callable[[int], None]) -> None:
+        """Run ``fn(key)`` when ``key`` completes (immediately if it
+        already has). Runs on the completing transport thread — keep it
+        non-blocking (blocking a van reader thread on a response from
+        the same server deadlocks the connection)."""
+        with self._cv:
+            if key in self._pending:
+                self._callbacks.setdefault(key, []).append(fn)
+                return
+        fn(key)
+
+    def wait(self, keys: Optional[Iterable[int]] = None,
+             timeout: Optional[float] = None) -> None:
+        """Block until the given keys (default: all) complete; raise
+        the recorded give-up errors with the wait()-compatible class
+        mapping, consuming them from the store's global list."""
+        klist = self._keys if keys is None else list(keys)
+        with self._cv:
+            if not self._cv.wait_for(
+                    lambda: all(k not in self._pending for k in klist),
+                    timeout):
+                left = [k for k in klist if k in self._pending]
+                raise TimeoutError(
+                    f"RoundFuture.wait: keys still pending {left}")
+            errs = [e for k in klist for e in self._errors.get(k, [])]
+        if errs:
+            if self._consume is not None:
+                self._consume(errs)
+            raise give_up_exc(errs)("transport gave up on "
+                                    + "; ".join(errs))
+
+    def result(self, key: int, timeout: Optional[float] = None):
+        """Join one key and return its result (the per-chunk consume
+        primitive — apply chunk i while chunk i+1 is still in flight)."""
+        self.wait([key], timeout)
+        with self._cv:
+            return self._results[key]
+
+    def results(self, timeout: Optional[float] = None) -> Dict[int, object]:
+        """Join the whole round; returns {key: result}."""
+        self.wait(timeout=timeout)
+        with self._cv:
+            return dict(self._results)

@@ -1136,28 +1136,38 @@ class KVStoreDistServer:
         # final decrement every other rank's callback has already
         # applied its part, so completion sees the full set
         resps = self.worker_global.take_response(ts)
-        by_key = {it[0]: it for it in items}
+        # a key can appear several times in one batch (P3 slicing gives
+        # one (key, off) state per slice): route each response entry to
+        # every item of that key whose slice range overlaps the data
+        by_key: Dict[int, List[tuple]] = {}
+        for it in items:
+            by_key.setdefault(it[0], []).append(it)
         acts: List[Action] = []
         for kvs in resps:
             for i, k in enumerate(kvs.keys):
-                it = by_key.get(int(k))
-                if it is None:
+                cands = by_key.get(int(k))
+                if not cands:
                     continue
-                key, off, cycle, lo, hi, total, _v, _a = it
+                r_off = kvs.offset_of(i)
+                match = next((c for c in cands if c[3] == r_off),
+                             cands[0])
                 data = np.asarray(kvs.vals[i]).ravel()
                 if kvs.compr:
                     data = self.gc.decompress_pull(
                         kvs.compr, data, kvs.aux[i],
-                        kvs.len_of(i) or hi - lo,
+                        kvs.len_of(i) or match[4] - match[3],
                         self._pull_compress_factor())
-                r_off = kvs.offset_of(i)
-                st = self._state(key, off)
-                with st.lock:
-                    if st.cycle != cycle:
-                        continue
+                for it in cands:
+                    key, off, cycle, lo, hi, total, _v, _a = it
                     lo2 = max(lo, r_off)
                     hi2 = min(hi, r_off + data.size)
-                    st.fwd_parts[lo2] = data[lo2 - r_off:hi2 - r_off]
+                    if hi2 <= lo2:
+                        continue
+                    st = self._state(key, off)
+                    with st.lock:
+                        if st.cycle != cycle:
+                            continue
+                        st.fwd_parts[lo2] = data[lo2 - r_off:hi2 - r_off]
         need_pull = []
         for key, off, cycle, lo, hi, total, _v, _a in items:
             st = self._state(key, off)
@@ -1215,33 +1225,41 @@ class KVStoreDistServer:
                                   cycle, g_rank, lo, hi, total)
             return
         resps = self.worker_global.take_response(ts)
-        # route each response entry to its (key, off) slice; within one
-        # batch a key appears once (slices are per-rank overlaps)
-        by_key = {it[0]: it for it in items}
+        # route each response entry to its (key, off) slice; a key can
+        # appear several times in one batch (P3 slicing gives one
+        # (key, off) state per slice), so match by range overlap
+        by_key: Dict[int, List[tuple]] = {}
+        for it in items:
+            by_key.setdefault(it[0], []).append(it)
         acts: List[Action] = []
         for kvs in resps:
             for i, k in enumerate(kvs.keys):
-                it = by_key.get(int(k))
-                if it is None:
+                cands = by_key.get(int(k))
+                if not cands:
                     continue
-                key, off, cycle, lo, hi, total = it
+                r_off = kvs.offset_of(i)
+                match = next((c for c in cands if c[3] == r_off),
+                             cands[0])
                 data = np.asarray(kvs.vals[i]).ravel()
                 if kvs.compr:
                     data = self.gc.decompress_pull(
                         kvs.compr, data, kvs.aux[i],
-                        kvs.len_of(i) or hi - lo,
+                        kvs.len_of(i) or match[4] - match[3],
                         self._pull_compress_factor())
-                r_off = kvs.offset_of(i)
-                st = self._state(key, off)
-                with st.lock:
-                    if st.cycle != cycle:
-                        continue
+                for it in cands:
+                    key, off, cycle, lo, hi, total = it
                     lo2 = max(lo, r_off)
                     hi2 = min(hi, r_off + data.size)
-                    st.fwd_parts[lo2] = data[lo2 - r_off:hi2 - r_off]
-                    if (len(st.fwd_parts) >= st.fwd_expected
-                            and st.fwd_expected > 0):
-                        acts += self._complete_global_round(st, key)
+                    if hi2 <= lo2:
+                        continue
+                    st = self._state(key, off)
+                    with st.lock:
+                        if st.cycle != cycle:
+                            continue
+                        st.fwd_parts[lo2] = data[lo2 - r_off:hi2 - r_off]
+                        if (len(st.fwd_parts) >= st.fwd_expected
+                                and st.fwd_expected > 0):
+                            acts += self._complete_global_round(st, key)
         for fn in acts:
             fn()
 
@@ -1334,11 +1352,17 @@ class KVStoreDistServer:
         independent timestamp counters, so (sender, timestamp) alone
         could collapse a local-tier and a global-tier request into one.
         Entries are (req, srv) on the local tier and (req, srv, lo, hi)
-        on the global tier (push+pull slice bookkeeping)."""
+        on the global tier (push+pull slice bookkeeping). The slice
+        range is part of the key: one multi-entry message can carry
+        SEVERAL slices of the same key into one canonical-range state
+        (P3 slicing), and each entry owes the message's countdown
+        responder its own ack — only same-range entries are true
+        duplicates."""
         seen = {}
         for t in reqs:
             r, s = t[0], t[1]
-            seen[(r.sender, r.timestamp, r.customer_id, id(s))] = t
+            seen[(r.sender, r.timestamp, r.customer_id, id(s))
+                 + tuple(t[2:])] = t
         return list(seen.values())
 
     def _offer_local(self, st: "_KeyState", key: int) -> List[Action]:

@@ -138,6 +138,16 @@ def scope(name: str, cat: str = "geomx", **args):
         record(name, cat, start, _now_us() - start, args or None)
 
 
+def chunk_scope(stage: str, chunk: int, **args):
+    """Scope tag for one pipeline chunk stage — ``stage`` is one of
+    fetch/send/recv/apply, ``chunk`` the chunk id — so traces show the
+    pipelined round's shape (which chunk was on the wire while which
+    was applying). Same exception-safe ``with`` discipline as the
+    server's per-key tags; near-free when the profiler is stopped."""
+    return scope(f"pipeline:{stage}:c{chunk}", cat="pipeline",
+                 chunk=chunk, **args)
+
+
 def instant(name: str, cat: str = "geomx", **args: Any) -> None:
     """Record an instant ('i') event — a point-in-time marker for things
     with no duration: snapshot writes, recovery restores, injected

@@ -140,6 +140,46 @@ def _unpack_kv(msg: Message) -> KVPairs:
     return kvs
 
 
+class OpFuture:
+    """Non-blocking handle for one KVWorker push/pull timestamp.
+
+    The op is issued with ``cb=fut._fire`` so the transport completes it
+    from the response (or give-up) callback; the future captures the
+    give-up reason at fire time (``take_failure`` is pop-once, and the
+    callback thread is the only place it is still guaranteed present).
+    ``wait()`` re-raises a give-up with the same class mapping as
+    ``KVStoreDist.wait()``."""
+
+    def __init__(self, worker: "KVWorker", ts: int):
+        self._worker = worker
+        self.ts = ts
+        self._done = threading.Event()
+        self._failure: Optional[str] = None
+
+    def _fire(self, ts: int) -> None:
+        self._failure = self._worker.take_failure(ts)
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def failure(self) -> Optional[str]:
+        """Give-up reason, if the transport abandoned the op."""
+        return self._failure
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"OpFuture.wait: ts={self.ts} still pending")
+        if self._failure is not None:
+            from geomx_tpu.kvstore.frontier import give_up_exc
+            raise give_up_exc([self._failure])(
+                f"transport gave up on ts={self.ts}: {self._failure}")
+
+    def responses(self) -> List[KVPairs]:
+        """Response data (combined push+pull acks / pulls); consume once."""
+        return self._worker.take_response(self.ts)
+
+
 class KVWorker:
     """Worker-side async push/pull client (reference: kv_app.h:80-426)."""
 
@@ -252,6 +292,23 @@ class KVWorker:
         )
         self.po.van.send(_pack_kv(meta, kvs))
         return ts
+
+    def push_future(self, kvs: KVPairs, server_rank: int = -1,
+                    **kw) -> OpFuture:
+        """:meth:`push` returning an :class:`OpFuture` instead of a raw
+        timestamp (no user ``cb`` — chain with ``fut.wait()``)."""
+        assert "cb" not in kw
+        fut = OpFuture(self, -1)
+        fut.ts = self.push(kvs, server_rank, cb=fut._fire, **kw)
+        return fut
+
+    def pull_future(self, keys: List[int], server_rank: int,
+                    **kw) -> OpFuture:
+        """:meth:`pull` returning an :class:`OpFuture`."""
+        assert "cb" not in kw
+        fut = OpFuture(self, -1)
+        fut.ts = self.pull(keys, server_rank, cb=fut._fire, **kw)
+        return fut
 
     def request(self, head: int, body: str, recver: int) -> int:
         """SimpleApp-style command (reference: simple_app.h via kv_app.h)."""

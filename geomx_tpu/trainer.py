@@ -34,41 +34,74 @@ __all__ = ["Trainer"]
 
 class Trainer:
     def __init__(self, params: Sequence[Any], kvstore,
-                 begin_key: int = 0, priority_descending: bool = True):
+                 begin_key: int = 0, priority_descending: bool = True,
+                 overlap: Optional[bool] = None):
         """``params``: list of array leaves; key of leaf i = begin_key+i.
 
         ``priority_descending`` pushes earlier (closer-to-output in the
         usual flatten order) keys at higher priority, matching the
         examples' ``priority=-idx`` P3 pattern.
+
+        ``overlap`` (default: the store's GEOMX_OVERLAP config) defers
+        ``step``'s round barrier to the point of first use: the next
+        ``leaves`` access — usually the next forward, or an HFA K2
+        global round riding behind K1 local steps — joins the in-flight
+        round. Sync semantics are unchanged (nothing reads stale
+        params); only the blocking moves.
         """
         self.kv = kvstore
         self.begin_key = begin_key
         self.priority_descending = priority_descending
-        self.leaves: List[np.ndarray] = [np.asarray(p) for p in params]
-        for i, leaf in enumerate(self.leaves):
+        if overlap is None:
+            overlap = bool(getattr(getattr(kvstore, "cfg", None),
+                                   "overlap", False))
+        self._overlap = overlap
+        self._dirty = False      # a step's round is still in flight
+        self._leaves: List[np.ndarray] = [np.asarray(p) for p in params]
+        for i, leaf in enumerate(self._leaves):
             self.kv.init(begin_key + i, leaf)
         if not getattr(self.kv, "is_master_worker", False):
-            for i in range(len(self.leaves)):
-                self.kv.pull(begin_key + i, out=self.leaves[i])
+            for i in range(len(self._leaves)):
+                self.kv.pull(begin_key + i, out=self._leaves[i])
         self.kv.wait()
+
+    @property
+    def leaves(self) -> List[np.ndarray]:
+        """Current parameters — the point of first use: joins any
+        in-flight overlapped round before handing them out."""
+        self.sync()
+        return self._leaves
+
+    def sync(self) -> None:
+        """Join the in-flight round, if any (the moved barrier)."""
+        if self._dirty:
+            self._dirty = False
+            self.kv.wait()
 
     # -- one update ------------------------------------------------------
 
     def step(self, grads: Sequence[Any], pull: bool = True) -> None:
-        """Push per-leaf gradients; pull back the updated parameters."""
-        assert len(grads) == len(self.leaves), (
-            f"got {len(grads)} grads for {len(self.leaves)} params")
+        """Push per-leaf gradients; pull back the updated parameters.
+        With overlap on, returns with the round in flight — the barrier
+        runs at the next ``leaves`` access instead of here."""
+        assert len(grads) == len(self._leaves), (
+            f"got {len(grads)} grads for {len(self._leaves)} params")
+        self.sync()   # at most one round in flight (same-buffer pulls)
         for i, g in enumerate(grads):
             prio = -i if self.priority_descending else 0
             key = self.begin_key + i
             self.kv.push(key, np.asarray(g), priority=prio)
             if pull:
-                self.kv.pull(key, out=self.leaves[i], priority=prio)
+                self.kv.pull(key, out=self._leaves[i], priority=prio)
+        if self._overlap and pull:
+            self._dirty = True
+            return
         self.kv.wait()
 
     def pull_all(self) -> None:
-        for i in range(len(self.leaves)):
-            self.kv.pull(self.begin_key + i, out=self.leaves[i])
+        self.sync()
+        for i in range(len(self._leaves)):
+            self.kv.pull(self.begin_key + i, out=self._leaves[i])
         self.kv.wait()
 
     # -- checkpoint ------------------------------------------------------

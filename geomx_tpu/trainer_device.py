@@ -47,9 +47,12 @@ grads, the standard treatment).
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Sequence, Tuple
 
 import numpy as np
+
+from geomx_tpu import profiler
+from geomx_tpu.kvstore.frontier import plan_chunks
 
 __all__ = ["DeviceResidentTrainer"]
 
@@ -128,8 +131,7 @@ class DeviceResidentTrainer:
         self._up_cap = m = nw * self.k
         K = self.k
 
-        @jax.jit
-        def fwd_compress(flat, u, v, X, y):
+        def select(flat, u, v, X, y):
             lv = [p.reshape(s) for p, s in
                   zip(jnp.split(flat, bounds), shapes)]
             loss, grads = grad_fn(lv, X, y)
@@ -148,6 +150,11 @@ class DeviceResidentTrainer:
             idx = jnp.concatenate(idx_parts)       # model-flat positions
             v = v.at[idx].set(0.0)
             u = u.at[idx].set(0.0)
+            return loss, vals, idx, u, v
+
+        @jax.jit
+        def fwd_compress(flat, u, v, X, y):
+            loss, vals, idx, u, v = select(flat, u, v, X, y)
             # single packed INT32 transfer: [loss, vals(K) bitcast i32,
             # idx(K)] — int lanes are denormal-safe (module docstring)
             packed = jnp.concatenate(
@@ -174,6 +181,75 @@ class DeviceResidentTrainer:
         self._sparse_wire = (hasattr(self.kv, "push_bsc")
                              and hasattr(self.kv, "pull_bsc"))
 
+        # -- pipelined round (GEOMX_OVERLAP + P3_SLICE_BYTES) ------------
+        # keys group in layer order into ~P3_SLICE_BYTES wire-byte
+        # chunks (~8 bytes per selected element); each chunk's D2H
+        # fetch, async combined round and jitted dynamic_update_slice
+        # apply flow independently — chunk i applies while chunk i+1's
+        # bytes are still on the wire. 0 = one chunk: the pipelined
+        # machinery with round-5 message counts.
+        kcfg = getattr(self.kv, "cfg", None)
+        self._pipeline = (bool(getattr(kcfg, "overlap", False))
+                          and self._sparse_wire
+                          and hasattr(self.kv, "push_pull_bsc_batch_async"))
+        if self._pipeline:
+            from functools import partial
+
+            chunks = plan_chunks(list(range(len(sizes))),
+                                 [8 * kk for kk in ks],
+                                 int(getattr(kcfg, "p3_slice_bytes", 0)))
+            self._chunks = chunks
+            # per chunk: selection range, flat param range, upload cap —
+            # chunk key runs are contiguous, so each covers one flat
+            # slice [flo, flo+fsize) and the slices partition [0, total)
+            meta = []
+            for ch in chunks:
+                a, b = ch.items[0], ch.items[-1]
+                sel_lo, sel_hi = int(self._kofs[a]), int(self._kofs[b + 1])
+                flo, fhi = int(self._offsets[a]), int(self._offsets[b + 1])
+                meta.append((sel_lo, sel_hi, flo, fhi - flo,
+                             nw * (sel_hi - sel_lo)))
+            self._chunk_meta = meta
+            sel_bounds = [(m[0], m[1]) for m in meta]
+
+            @jax.jit
+            def fwd_chunks(flat, u, v, X, y):
+                loss, vals, idx, u, v = select(flat, u, v, X, y)
+                # one packed int32 array PER CHUNK so the host can fetch
+                # and dispatch each chunk independently; loss rides
+                # separately (fetching its value fences the program)
+                packs = tuple(
+                    jnp.concatenate(
+                        [jax.lax.bitcast_convert_type(vals[lo:hi],
+                                                      jnp.int32),
+                         idx[lo:hi]])
+                    for lo, hi in sel_bounds)
+                return loss.astype(jnp.float32), packs, u, v
+
+            @partial(jax.jit, static_argnums=(3, 4))
+            def apply_chunk(flat, mom, up, flo, fsize):
+                # up layout mirrors apply_sgd but chunk-local: [vals(cap)
+                # bitcast i32, idx(cap) CHUNK-relative]; pad slots are
+                # (0.0, 0) — a scatter-add no-op, and position 0 of the
+                # chunk is a real coordinate so adding 0.0 is exact
+                # (aggregated nonzeros are never ±0.0)
+                cap = up.shape[0] // 2
+                vals = jax.lax.bitcast_convert_type(up[:cap], jnp.float32)
+                cidx = up[cap:]
+                g = jnp.zeros((fsize,), flat.dtype).at[cidx].add(vals)
+                seg = jax.lax.dynamic_slice(flat, (flo,), (fsize,))
+                if mom is None:
+                    return (jax.lax.dynamic_update_slice(
+                        flat, seg - learning_rate * g, (flo,)), None)
+                mseg = jax.lax.dynamic_slice(mom, (flo,), (fsize,))
+                mseg = momentum * mseg + g
+                return (jax.lax.dynamic_update_slice(
+                            flat, seg - learning_rate * mseg, (flo,)),
+                        jax.lax.dynamic_update_slice(mom, mseg, (flo,)))
+
+            self._fwd_chunks = fwd_chunks
+            self._apply_chunk = apply_chunk
+
     def warmup(self, X, y) -> None:
         """Trace+compile both device steps WITHOUT running a kv round
         (results discarded, trainer state untouched) — lets callers
@@ -185,15 +261,32 @@ class DeviceResidentTrainer:
                                             self._v, X, y)
         up = jax.device_put(np.zeros(2 * self._up_cap, np.int32))
         flat2, _mom2 = self._apply(self._flat, self._mom, up)
-        jax.block_until_ready((packed, flat2))
+        fence = [packed, flat2]
+        if self._pipeline:
+            loss_d, packs, _u2, _v2 = self._fwd_chunks(
+                self._flat, self._u, self._v, X, y)
+            fence.extend([loss_d, *packs])
+            for _lo, _hi, flo, fsize, cap in self._chunk_meta:
+                up0 = jax.device_put(np.zeros(2 * cap, np.int32))
+                f2, _m2 = self._apply_chunk(self._flat, self._mom,
+                                            up0, flo, fsize)
+                fence.append(f2)
+        jax.block_until_ready(fence)
 
     # -- one round -------------------------------------------------------
 
     def step(self, X, y) -> float:
         """One FSA round: device grad+compress, HiPS aggregate, device
-        sparse apply. Returns the loss (device-computed, host float)."""
+        sparse apply. Returns the loss (device-computed, host float).
+
+        With the pipelined path active (GEOMX_OVERLAP and an async
+        sparse wire) the round runs per chunk — dispatch every chunk's
+        fetch+send first, then apply each as its aggregate lands —
+        same post-round state, overlapped wall clock."""
         import jax
 
+        if self._pipeline:
+            return self._step_pipelined(X, y)
         packed_d, self._u, self._v = self._fwd_compress(
             self._flat, self._u, self._v, X, y)
         # ONE compact device->host transfer (1 + 2K int32 vs total)
@@ -219,6 +312,147 @@ class DeviceResidentTrainer:
         self._flat, self._mom = self._apply(
             self._flat, self._mom, jax.device_put(up))
         return loss
+
+    def _chunk_wire_parts(self, ci: int, arr: np.ndarray):
+        """Split chunk ``ci``'s fetched pack into the per-key wire lists
+        (keys, values, KEY-relative indices) push_pull_bsc_batch expects."""
+        sel_lo, sel_hi, _flo, _fsize, _cap = self._chunk_meta[ci]
+        kc = sel_hi - sel_lo
+        vals = arr[:kc].view(np.float32)
+        aidx = arr[kc:].astype(np.int64)
+        keys, vlist, ilist = [], [], []
+        for i in self._chunks[ci].items:
+            lo = int(self._kofs[i]) - sel_lo
+            hi = int(self._kofs[i + 1]) - sel_lo
+            keys.append(self.begin_key + i)
+            vlist.append(vals[lo:hi])
+            ilist.append(aidx[lo:hi] - int(self._offsets[i]))
+        return keys, vlist, ilist
+
+    def _chunk_up(self, ci: int, agg: Dict) -> np.ndarray:
+        """Assemble chunk ``ci``'s fixed-size upload from its keys'
+        aggregated (values, key-relative indices): [vals(cap) bitcast
+        i32, idx(cap) chunk-relative], zero-padded."""
+        _sel_lo, _sel_hi, flo, _fsize, cap = self._chunk_meta[ci]
+        ups, upi = [], []
+        for i in self._chunks[ci].items:
+            avals, aidx = agg[self.begin_key + i]
+            ups.append(avals)
+            upi.append(aidx + (int(self._offsets[i]) - flo))
+        cat_v = np.concatenate(ups)
+        cat_i = np.concatenate(upi)
+        n = len(cat_v)
+        if n > cap:
+            raise RuntimeError(
+                f"aggregated selection ({n}) exceeds chunk upload "
+                f"capacity ({cap}) — is the PS tier running an "
+                "optimizer? DeviceResidentTrainer requires aggregator "
+                "mode")
+        up = np.zeros(2 * cap, np.int32)
+        up[:n] = np.asarray(cat_v, np.float32).view(np.int32)
+        up[cap:cap + n] = cat_i.astype(np.int32)
+        return up
+
+    def _step_pipelined(self, X, y) -> float:
+        """Chunked overlapped round: fetch+dispatch every chunk in
+        layer order (priority -chunk), then apply each chunk's
+        aggregate as it arrives. Chunk flat ranges partition [0, total)
+        and the arithmetic per coordinate is identical to the
+        monolithic apply, so the post-round state is bit-identical to
+        the serial path."""
+        import jax
+
+        loss_d, packs, self._u, self._v = self._fwd_chunks(
+            self._flat, self._u, self._v, X, y)
+        for p in packs:
+            if hasattr(p, "copy_to_host_async"):
+                p.copy_to_host_async()
+        futs = []
+        for ci in range(len(self._chunks)):
+            with profiler.chunk_scope("fetch", ci):
+                arr = np.asarray(packs[ci])
+            keys, vlist, ilist = self._chunk_wire_parts(ci, arr)
+            # slice_bytes=0: this call IS one chunk — one message per
+            # server, the store must not re-slice it
+            futs.append(self.kv.push_pull_bsc_batch_async(
+                keys, vlist, ilist, priority=-ci, slice_bytes=0))
+        # loss value-fetch rides behind the dispatches (the wire is
+        # already flying when this blocks on the device)
+        loss = float(np.asarray(loss_d))
+        for ci, fut in enumerate(futs):
+            agg = fut.results()
+            up = self._chunk_up(ci, agg)
+            _sel_lo, _sel_hi, flo, fsize, _cap = self._chunk_meta[ci]
+            with profiler.chunk_scope("apply", ci):
+                self._flat, self._mom = self._apply_chunk(
+                    self._flat, self._mom, jax.device_put(up),
+                    flo, fsize)
+        return loss
+
+    def step_timed(self, X, y) -> Tuple[float, Dict[str, float]]:
+        """One round with an honest per-phase wall-ms breakdown
+        (compute / d2h / wire / h2d / apply), every phase fenced on a
+        VALUE fetch or explicit block (PERF.md round-5 honesty rules).
+        Phases run serially — overlap is deliberately OFF here so each
+        bucket is attributable; use it for auditing (bench.py round
+        breakdown), not throughput."""
+        import time
+
+        import jax
+
+        assert self._sparse_wire, "step_timed needs the sparse wire"
+        t0 = time.perf_counter()
+        if self._pipeline:
+            loss_d, packs, self._u, self._v = self._fwd_chunks(
+                self._flat, self._u, self._v, X, y)
+            loss = float(np.asarray(loss_d))   # fences the fwd program
+            t1 = time.perf_counter()
+            arrs = [np.asarray(p) for p in packs]
+            t2 = time.perf_counter()
+            futs = [self.kv.push_pull_bsc_batch_async(
+                        *self._chunk_wire_parts(ci, arrs[ci]),
+                        priority=-ci, slice_bytes=0)
+                    for ci in range(len(self._chunks))]
+            aggs = [f.results() for f in futs]
+            t3 = time.perf_counter()
+            ups_d = [jax.device_put(self._chunk_up(ci, aggs[ci]))
+                     for ci in range(len(self._chunks))]
+            jax.block_until_ready(ups_d)
+            t4 = time.perf_counter()
+            for ci, up_d in enumerate(ups_d):
+                _sl, _sh, flo, fsize, _cap = self._chunk_meta[ci]
+                self._flat, self._mom = self._apply_chunk(
+                    self._flat, self._mom, up_d, flo, fsize)
+        else:
+            packed_d, self._u, self._v = self._fwd_compress(
+                self._flat, self._u, self._v, X, y)
+            loss = float(np.asarray(packed_d[0:1])
+                         .view(np.float32)[0])  # value fetch = fence
+            t1 = time.perf_counter()
+            packed = np.asarray(packed_d)
+            t2 = time.perf_counter()
+            vals = packed[1:1 + self._K].view(np.float32)
+            idx = packed[1 + self._K:].astype(np.int64)
+            ups, upi = self._kv_round_sparse(vals, idx)
+            t3 = time.perf_counter()
+            n = len(ups)
+            up = np.zeros(2 * self._up_cap, np.int32)
+            up[:n] = np.asarray(ups, np.float32).view(np.int32)
+            up[self._up_cap:self._up_cap + n] = upi.astype(np.int32)
+            up_d = jax.device_put(up)
+            jax.block_until_ready(up_d)
+            t4 = time.perf_counter()
+            self._flat, self._mom = self._apply(self._flat, self._mom,
+                                                up_d)
+        float(np.asarray(self._flat[0:1])[0])   # value fetch = fence
+        t5 = time.perf_counter()
+        return loss, {
+            "compute_ms": (t1 - t0) * 1e3,
+            "d2h_ms": (t2 - t1) * 1e3,
+            "wire_ms": (t3 - t2) * 1e3,
+            "h2d_ms": (t4 - t3) * 1e3,
+            "apply_ms": (t5 - t4) * 1e3,
+        }
 
     # -- host-side kv round ----------------------------------------------
 

@@ -54,4 +54,15 @@ run_case partition \
   '[{"type": "partition", "between": [8, "*"], "start_s": 5.0, "duration_s": 3.0}]' \
   9690 "$@"
 
+# pipelined round (async chunked push_pull, P3 slicing) under drops,
+# reordering and duplicates: chunk responses land out of order and some
+# retransmit; training must still complete with the same convergence
+export GEOMX_OVERLAP=1 P3_SLICE_BYTES=131072
+run_case overlap \
+  '[{"type": "drop", "p": 0.1},
+    {"type": "reorder", "window": 4},
+    {"type": "dup", "p": 0.05}]' \
+  9790 "$@"
+unset GEOMX_OVERLAP P3_SLICE_BYTES
+
 exit $FAILED

@@ -110,6 +110,64 @@ def run_sparse(shapes, threshold: float, rounds: int) -> float:
     return max(times.values())
 
 
+def run_overlap(shapes, rounds: int, slice_bytes: int):
+    """Serial vs pipelined combined round: the same dense push_pull
+    payloads, once through the blocking wire (push_pull + wait) and
+    once through the async chunked wire (push_pull_async at
+    ``slice_bytes``-budget P3 chunks, joined per round). Per-key host
+    work between dispatch and join is what the pipeline hides."""
+    from geomx_tpu.optimizer import SGD
+    from geomx_tpu.simulate import InProcessHiPS
+
+    keys = list(range(len(shapes)))
+    topo = InProcessHiPS(num_parties=2, workers_per_party=1,
+                         extra_cfg={"p3_slice_bytes": slice_bytes}
+                         ).start()
+    times = {}
+    nchunks = [0]
+    try:
+        def master_init(kv):
+            kv.set_optimizer(SGD(learning_rate=0.01))
+            for k, sh in zip(keys, shapes):
+                kv.init(k, np.zeros(sh, np.float32))
+            kv.wait()
+
+        def worker(kv):
+            outs = [np.zeros(sh, np.float32) for sh in shapes]
+            grads = [np.ones(sh, np.float32) for sh in shapes]
+            for k, o in zip(keys, outs):
+                kv.init(k, o.copy())
+                kv.pull(k, out=o)
+            kv.wait()
+            from geomx_tpu.kvstore.frontier import plan_chunks
+            entries = []
+            for k in keys:
+                info = kv._key_info[k]
+                entries.extend((sh.length * 4,) for sh in info.shards)
+            nchunks[0] = len(plan_chunks(
+                list(range(len(entries))), [e[0] for e in entries],
+                slice_bytes))
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                kv.push_pull(keys, grads, out=outs)
+                kv.wait()
+            serial = (time.perf_counter() - t0) / rounds * 1e3
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                fut = kv.push_pull_async(keys, grads, outs,
+                                         slice_bytes=slice_bytes)
+                fut.wait()
+            piped = (time.perf_counter() - t0) / rounds * 1e3
+            times[id(kv)] = (serial, piped)
+
+        topo.run_workers(worker, include_master=master_init, timeout=600)
+    finally:
+        topo.stop()
+    serial = max(t[0] for t in times.values())
+    piped = max(t[1] for t in times.values())
+    return serial, piped, nchunks[0]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--layout", choices=sorted(LAYOUTS), default="cnn")
@@ -120,6 +178,11 @@ def main():
                          "of the dense push/pull wire")
     ap.add_argument("--threshold", type=float, default=0.01,
                     help="--sparse: top-k fraction per key")
+    ap.add_argument("--overlap", action="store_true",
+                    help="measure serial vs pipelined combined round "
+                         "(push_pull vs async chunked push_pull_async)")
+    ap.add_argument("--slice-bytes", type=int, default=131072,
+                    help="--overlap: P3 chunk budget in bytes")
     args = ap.parse_args()
 
     shapes = LAYOUTS[args.layout]
@@ -127,6 +190,16 @@ def main():
         rng = np.random.RandomState(0)
         shapes = [(int(s),)
                   for s in rng.choice([64, 512, 2048, 8192], 75)]
+    if args.overlap:
+        serial, piped, nchunks = run_overlap(shapes, args.rounds,
+                                             args.slice_bytes)
+        print(json.dumps({
+            "layout": args.layout, "keys": len(shapes), "overlap": True,
+            "slice_bytes": args.slice_bytes, "chunks": nchunks,
+            "serial_ms_per_round": round(serial, 2),
+            "pipelined_ms_per_round": round(piped, 2),
+            "speedup": round(serial / piped, 2)}))
+        return
     if args.sparse:
         ms = run_sparse(shapes, args.threshold, args.rounds)
         print(json.dumps({
