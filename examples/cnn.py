@@ -109,6 +109,10 @@ def main():
     print(f"Start training on {num_all_workers} workers, my rank is {my_rank}.")
     for epoch in range(start_epoch, args.epoch):
         for X, y in train_iter:
+            if hasattr(kv, "notify_round"):
+                # FaultPlan "crash at_round N" rules key off this
+                # counter (chaos matrix worker-kill case)
+                kv.notify_round(global_iters)
             loss, grads = grad_step([jnp.asarray(l) for l in leaves],
                                     jnp.asarray(X), jnp.asarray(y))
             # combined push_pull: ONE message per server per round (the

@@ -179,6 +179,16 @@ class Config:
     # multi-server tiers: replicate snapshot deltas to the next-rank
     # peer so a dead server's replacement can restore without a disk
     replicate: bool = True              # PS_REPLICATE
+    # elastic membership: how long (seconds) a heartbeat lapse must
+    # persist past PS_HEARTBEAT_TIMEOUT before the scheduler DECLARES
+    # the node dead (epoch bump + DEAD_NODE broadcast); 0 = declare as
+    # soon as the lapse is observed. Requires PS_HEARTBEAT_INTERVAL > 0.
+    epoch_grace_s: float = 0.0          # PS_EPOCH_GRACE
+    # bounded per-chunk retry budget for the async chunked rounds
+    # (push_pull_async / push_pull_bsc_batch_async): a failed chunk is
+    # re-issued up to this many times before its give-up error surfaces
+    # through the RoundFuture; 0 = no retries (the old behavior)
+    chunk_retries: int = 0              # PS_CHUNK_RETRIES
     verbose: int = 0                    # PS_VERBOSE
     # round-4 verdict item 2: the reference makes its transport deadlines
     # env-tunable (van.cc:527-533 PS_RESEND_TIMEOUT / heartbeat envs);
@@ -283,6 +293,8 @@ def load() -> Config:
         snapshot_dir=env_str("PS_SNAPSHOT_DIR"),
         snapshot_interval_s=env_float("PS_SNAPSHOT_INTERVAL", 5.0),
         replicate=env_bool("PS_REPLICATE", True),
+        epoch_grace_s=env_float("PS_EPOCH_GRACE", 0.0),
+        chunk_retries=env_int("PS_CHUNK_RETRIES", 0),
         verbose=env_int("PS_VERBOSE", 0),
         barrier_timeout_s=env_float("PS_BARRIER_TIMEOUT", 600.0),
         op_timeout_s=env_float("PS_OP_TIMEOUT", 300.0),

@@ -56,10 +56,16 @@ class ESyncStateServer:
 
     def __init__(self, cap: int = DEFAULT_CAP,
                  stale_rounds: float = STALE_ROUNDS,
-                 time_fn=time.monotonic):
+                 time_fn=time.monotonic, live_fn=None):
         self.cap = cap
         self.stale_rounds = float(stale_rounds)
         self._time_fn = time_fn          # injectable for tests
+        # membership hook: () -> iterable of LIVE worker ids. When the
+        # hosting PS wires it (kvstore/server.py), a worker the scheduler
+        # declared dead leaves the reach table on its NEXT report instead
+        # of lingering for stale_rounds * T — the epoch view and the
+        # time-based ageing agree on who counts
+        self.live_fn = live_fn
         self._lock = threading.Lock()
         # sender id -> (tau_ema, c_ema, last_report_time)
         self._times: Dict[int, tuple] = {}
@@ -83,12 +89,23 @@ class ESyncStateServer:
             window = max(self.stale_rounds * reach_all, 1e-3)
             self._times = {s: e for s, e in self._times.items()
                            if now - e[2] <= window}
+            if self.live_fn is not None:
+                # membership epoch view: declared-dead reporters leave
+                # immediately (the reporting sender always counts — its
+                # report IS evidence of life)
+                live = set(self.live_fn()) | {sender}
+                self._times = {s: e for s, e in self._times.items()
+                               if s in live}
             reach = max(t + c for t, c, _ in self._times.values())
             m = int((reach - c_s) / tau_s)
         return max(1, min(m, self.cap))
 
     def live_workers(self) -> int:
-        """Number of workers with a non-stale report (observability)."""
+        """Number of workers that count toward reach-time balancing:
+        the membership epoch's live view when wired (``live_fn``), the
+        non-stale report table otherwise (observability)."""
+        if self.live_fn is not None:
+            return len(set(self.live_fn()))
         with self._lock:
             return len(self._times)
 

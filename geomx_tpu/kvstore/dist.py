@@ -39,7 +39,7 @@ from geomx_tpu import config as cfg_mod
 from geomx_tpu import profiler
 from geomx_tpu.kvstore import sharding
 from geomx_tpu.kvstore.base import Command, DATA_INIT, KVStore, _sum_values
-from geomx_tpu.kvstore.frontier import RoundFuture, plan_chunks
+from geomx_tpu.kvstore.frontier import RoundFuture, give_up_exc, plan_chunks
 from geomx_tpu.ps import base as psbase
 from geomx_tpu.ps.kv_app import KVPairs, KVWorker
 from geomx_tpu.ps.message import Role
@@ -49,14 +49,11 @@ log = logging.getLogger("geomx.dist")
 
 
 def _give_up_exc(errs) -> type:
-    """Exception class for surfacing transport give-ups: a blown
-    PS_RESEND_DEADLINE (the resender tags it "delivery deadline") is a
-    TimeoutError at the issuing customer; retry-cap give-ups stay
-    RuntimeError. Callback-driven ops only see the reason STRING
-    (Customer.on_fail), so the class is recovered from it here."""
-    return (TimeoutError
-            if any("delivery deadline" in e for e in errs)
-            else RuntimeError)
+    """Exception class for surfacing transport give-ups — one mapping,
+    shared with RoundFuture (kvstore.frontier.give_up_exc): "declared
+    dead" raises WorkerLostError, a blown PS_RESEND_DEADLINE is a
+    TimeoutError, retry-cap give-ups stay RuntimeError."""
+    return give_up_exc(errs)
 
 
 class _KeyInfo:
@@ -154,8 +151,27 @@ class KVStoreDist(KVStore):
     def is_master_worker(self) -> bool:
         return self.cfg.is_master_worker
 
-    def get_num_dead_node(self) -> int:
-        return self.po.num_dead_nodes()
+    def get_num_dead_node(self, role=None) -> int:
+        """Dead-node count, optionally filtered by role ("worker" /
+        "server" or a ps.message.Role), mirroring the reference's
+        GetDeadNodes(role). Emits the count as a profiler gauge so
+        operators can watch membership shrink."""
+        if isinstance(role, str):
+            role = {"worker": Role.WORKER, "server": Role.SERVER}[
+                role.lower()]
+        n = self.po.num_dead_nodes(role=role)
+        tag = ("dead_nodes" if role is None
+               else f"dead_{Role(role).name.lower()}s")
+        profiler.counter(f"membership.{tag}", n, cat="membership")
+        return n
+
+    def membership_epoch(self) -> int:
+        return self.po.membership_epoch()
+
+    def notify_round(self, round_idx: int) -> None:
+        """Advance the training-round clock (deterministic FaultPlan
+        kill-at-round rules consult it)."""
+        self.po.van.notify_round(round_idx)
 
     # -- helpers ---------------------------------------------------------
 
@@ -544,7 +560,8 @@ class KVStoreDist(KVStore):
         chunks = plan_chunks(list(range(len(entries))),
                              [e[2].nbytes for e in entries],
                              sb, base_priority=priority)
-        fut = RoundFuture(keys, consume=self._consume_errors)
+        fut = RoundFuture(keys, consume=self._consume_errors,
+                          max_retries=self.cfg.chunk_retries)
         bufs = {k: np.zeros(self._key_info[k].total, np.float32)
                 for k in keys}
         out_of = dict(zip(keys, outs))
@@ -583,8 +600,23 @@ class KVStoreDist(KVStore):
         got_data: set = set()
 
         def on_resp(ts: int, mid: int):
-            _m, cid, srank, _kvs, mks, _p = msgs[mid]
+            _m, cid, srank, m_kvs, mks, m_prio = msgs[mid]
             fail = self.kvw.take_failure(ts)
+            # bounded per-chunk retry (PS_CHUNK_RETRIES): transient
+            # give-ups re-issue the identical message — bookkeeping
+            # (msgs_left, push acks, tracking) stays registered until a
+            # terminal response lands. "declared dead" never retries:
+            # that peer is gone for the epoch; surface WorkerLostError.
+            if (fail is not None and "declared dead" not in fail
+                    and fut.retry_budget(cid)):
+                log.warning("push_pull_async chunk %d to server %d "
+                            "failed (%s); retry %d/%d", cid, srank,
+                            fail, fut.retries_used(cid), fut.max_retries)
+                profiler.instant("chunk.retry", cat="kvstore",
+                                 chunk=cid, server=srank)
+                self.kvw.push(m_kvs, srank, priority=m_prio, pull=True,
+                              cb=lambda ts2, m=mid: on_resp(ts2, m))
+                return
             failed_keys = []
             if fail is not None:
                 with self._lock:
@@ -1256,7 +1288,8 @@ class KVStoreDist(KVStore):
         sizes = [np.asarray(v).size * 8 for v in values_list]
         chunks = plan_chunks(list(range(len(keys))), sizes, sb,
                              base_priority=priority)
-        fut = RoundFuture(keys, consume=self._consume_errors)
+        fut = RoundFuture(keys, consume=self._consume_errors,
+                          max_retries=self.cfg.chunk_retries)
         parts: Dict[int, List] = {k: [] for k in keys}
         expected_parts: Dict[int, int] = {}
         msgs = []  # (mid, cid, srank, kvs, msg_keys, chunk_priority)
@@ -1285,8 +1318,21 @@ class KVStoreDist(KVStore):
                 self._track(1, k)
 
         def on_resp(ts: int, mid: int):
-            _m, cid, srank, _kvs, mks, _p = msgs[mid]
+            _m, cid, srank, m_kvs, mks, m_prio = msgs[mid]
             fail = self.kvw.take_failure(ts)
+            # same bounded retry as push_pull_async's on_resp: re-issue
+            # the identical chunk message while the budget lasts, except
+            # to declared-dead peers (epoch recovery handles those)
+            if (fail is not None and "declared dead" not in fail
+                    and fut.retry_budget(cid)):
+                log.warning("push_pull_bsc_async chunk %d to server %d "
+                            "failed (%s); retry %d/%d", cid, srank,
+                            fail, fut.retries_used(cid), fut.max_retries)
+                profiler.instant("chunk.retry", cat="kvstore",
+                                 chunk=cid, server=srank)
+                self.kvw.push(m_kvs, srank, priority=m_prio, pull=True,
+                              cb=lambda ts2, m=mid: on_resp(ts2, m))
+                return
             failed_keys = []
             if fail is not None:
                 with self._lock:

@@ -32,15 +32,35 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
-__all__ = ["give_up_exc", "Chunk", "plan_chunks", "RoundFuture"]
+__all__ = ["give_up_exc", "Chunk", "plan_chunks", "RoundFuture",
+           "RoundAborted", "WorkerLostError"]
+
+
+class RoundAborted(RuntimeError):
+    """A communication round cannot complete as issued (membership
+    changed mid-round, or the transport abandoned part of it in a way
+    the trainer can recover from by re-issuing against the new epoch)."""
+
+
+class WorkerLostError(RoundAborted):
+    """A peer this round depended on was declared dead (membership
+    epoch bump). Subclasses :class:`RoundAborted` so one handler covers
+    both: catch, re-pull weights, re-issue the round."""
 
 
 def give_up_exc(errs: Iterable[str]) -> type:
-    """Exception class for surfacing transport give-ups: a blown
-    PS_RESEND_DEADLINE (the resender tags it "delivery deadline") is a
-    TimeoutError at the issuing customer; retry-cap give-ups stay
-    RuntimeError. Callback-driven ops only see the reason STRING
-    (Customer.on_fail), so the class is recovered from it here."""
+    """Exception class for surfacing transport give-ups: a peer death
+    declared by the scheduler (the resender tags it "declared dead")
+    raises WorkerLostError; a blown PS_RESEND_DEADLINE (tagged
+    "delivery deadline") is a TimeoutError at the issuing customer;
+    retry-cap give-ups stay RuntimeError. Callback-driven ops only see
+    the reason STRING (Customer.on_fail), so the class is recovered
+    from it here."""
+    errs = list(errs)
+    if any("declared dead" in e for e in errs):
+        return WorkerLostError
+    if any("round aborted" in e for e in errs):
+        return RoundAborted
     return (TimeoutError
             if any("delivery deadline" in e for e in errs)
             else RuntimeError)
@@ -105,7 +125,8 @@ class RoundFuture:
     contract of the PR-r5 BSC joins)."""
 
     def __init__(self, keys: Iterable[int],
-                 consume: Optional[Callable[[List[str]], None]] = None):
+                 consume: Optional[Callable[[List[str]], None]] = None,
+                 max_retries: int = 0):
         self._cv = threading.Condition()
         self._keys: List[int] = list(keys)
         self._pending = set(self._keys)
@@ -115,12 +136,31 @@ class RoundFuture:
         self._errors: Dict[int, List[str]] = {}
         self._callbacks: Dict[int, List[Callable[[int], None]]] = {}
         self._consume = consume
+        # bounded per-chunk retry budget (PS_CHUNK_RETRIES): the issuing
+        # store consults retry_budget(cid) before re-issuing a failed
+        # chunk instead of recording its error
+        self.max_retries = max_retries
+        self._retries: Dict[int, int] = {}
 
     @property
     def keys(self) -> List[int]:
         return list(self._keys)
 
     # -- completion (transport-callback side) -----------------------------
+
+    def retry_budget(self, cid: int) -> bool:
+        """Consume one retry for chunk ``cid``; False once exhausted
+        (then the failure is recorded via :meth:`add_error` instead)."""
+        with self._cv:
+            used = self._retries.get(cid, 0)
+            if used >= self.max_retries:
+                return False
+            self._retries[cid] = used + 1
+            return True
+
+    def retries_used(self, cid: int) -> int:
+        with self._cv:
+            return self._retries.get(cid, 0)
 
     def add_error(self, key: int, err: str) -> None:
         """Record a transport give-up for ``key`` without completing it

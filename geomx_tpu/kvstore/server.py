@@ -318,6 +318,11 @@ class KVStoreDistServer:
 
     def start(self, timeout: float = 120.0) -> None:
         self.po_local.start(timeout)
+        # elastic membership: epoch bumps re-check every pending
+        # aggregation countdown, and esync's reporter window tracks the
+        # same live view the countdowns use
+        self.po_local.add_membership_listener(self._on_membership)
+        self._esync.live_fn = self.po_local.live_worker_ids
         self.server_local = KVServer(self.po_local)
         self.server_local.set_request_handle(
             lambda req, kvs, srv: self._handle(req, kvs, srv, global_tier=False))
@@ -345,6 +350,7 @@ class KVStoreDistServer:
                 # breaks unless they name the same process
                 self.po_global.van.sort_key = self.po_local.my_rank
             self.po_global.start(timeout)
+            self.po_global.add_membership_listener(self._on_membership)
             if self.is_global_server:
                 self.server_global = KVServer(self.po_global)
                 self.server_global.set_request_handle(
@@ -434,6 +440,54 @@ class KVStoreDistServer:
         # itself is already stopped; crash() re-stopping it is a no-op)
         self.crash()
 
+    def _on_membership(self, epoch: int, dead: frozenset) -> None:
+        """Membership epoch bump (the scheduler declared nodes dead):
+        rounds mid-flight may now be complete — the corpse's push is
+        never coming — so re-run every pending countdown against the
+        LIVE view and release what finishes (the elastic-membership
+        round release). Runs on a van thread; acks and WAN forwards
+        fire outside the per-state locks like every other handler."""
+        with self._lock:
+            items = list(self._states.items())
+        acts: List[Action] = []
+        released = 0
+        for (key, _off), st in items:
+            with st.lock:
+                if self.is_global_server:
+                    # FSA store: every state on a global server
+                    if (st.initialized and st.merged is not None
+                            and st.elems_received > 0
+                            and st.elems_received
+                            >= self._expected_global_elems(st)):
+                        acts += self._complete_fsa_round(st, key)
+                        released += 1
+                elif (st.stored is not None and st.push_reqs
+                        and not st.staging
+                        and len(st.push_reqs)
+                        >= self._expected_local_pushes()):
+                    acts += self._complete_local_round(st, key)
+                    released += 1
+        if released:
+            log.warning("membership epoch %d (dead=%s): released %d "
+                        "stalled aggregation round(s)", epoch,
+                        sorted(dead), released)
+            profiler.instant("membership.rounds_released",
+                             cat="membership", epoch=epoch, n=released)
+        for fn in acts:
+            fn()
+        # the cross-party worker barrier may be satisfied now too
+        self._recheck_global_barrier()
+        # and the stop countdown (a dead global worker's cascaded stop
+        # never arrives)
+        if self.is_global_server:
+            with self._lock:
+                n_gw = (self.po_global.num_live_workers()
+                        if self.po_global else 0)
+                done = (self._stops_received > 0
+                        and self._stops_received >= max(n_gw, 1))
+            if done:
+                self._stop.set()
+
     # ------------------------------------------------------------------
     # request entry (reference: DataHandleEx, kvstore_dist_server.h:432)
     # ------------------------------------------------------------------
@@ -456,6 +510,24 @@ class KVStoreDistServer:
 
     def _handle_data(self, req: ReqMeta, kvs: KVPairs, srv: KVServer,
                      global_store: bool, global_tier: bool) -> None:
+        if req.push and not req.simple_app:
+            # zombie fencing: a push from a sender this tier has declared
+            # dead — or one stamped with the sender's pre-rejoin epoch —
+            # must never aggregate (it would double-count against the
+            # live round sized without it). Dropped WITHOUT an ack: the
+            # corpse's resender gives up on its own, and a rejoined
+            # sender's fresh pushes carry the new epoch and pass.
+            van = (self.po_global.van
+                   if global_tier and self.po_global is not None
+                   else self.po_local.van)
+            if van.is_stale(req.sender, req.epoch):
+                log.warning("dropping stale push from node %d "
+                            "(epoch %d, membership epoch %d)",
+                            req.sender, req.epoch, van.membership_epoch)
+                profiler.instant("membership.stale_push_dropped",
+                                 cat="membership", sender=req.sender,
+                                 epoch=req.epoch)
+                return
         acts: List[Action] = []
         if len(kvs.keys) > 1:
             # multi-key request: N independent per-key machines each ack
@@ -588,9 +660,22 @@ class KVStoreDistServer:
             if not kernels_native.acc(st.merged, v32):
                 st.merged += v32
         st.push_reqs.extend([(req, srv)] * max(req.num_merge, 1))
-        if len(st.push_reqs) < self.po_local.num_workers:
+        if len(st.push_reqs) < self._expected_local_pushes():
             return []
+        return self._complete_local_round(st, key)
 
+    def _expected_local_pushes(self) -> int:
+        """Local-round countdown target: one push per LIVE worker. Sized
+        from the membership view at check time so a worker declared dead
+        mid-round stops being waited for — the survivors' pushes release
+        the round (elastic membership)."""
+        return max(self.po_local.num_live_workers(), 1)
+
+    def _complete_local_round(self, st, key) -> List[Action]:
+        """The round-complete tail of :meth:`_push_local_store` (runs
+        under ``st.lock``); also invoked by :meth:`_on_membership` when
+        an epoch bump shrinks the countdown below what already arrived."""
+        off = st.offset
         # round complete (reference: :1324)
         st.rounds += 1
         reqs, st.push_reqs = st.push_reqs, []
@@ -620,7 +705,7 @@ class KVStoreDistServer:
             if st.milestone is None:
                 st.milestone = st.stored.astype(np.float32, copy=True)
             payload = (st.merged - st.milestone) / max(
-                self.po_global.num_workers, 1)
+                self.po_global.num_live_workers(), 1)
         else:
             payload = st.merged
         # stage the outbound aggregate in its OWN slot (`stored` keeps the
@@ -787,22 +872,36 @@ class KVStoreDistServer:
                     "party runs the same number of local servers)",
                     dict(self._party_nsrv_by_sender))
             self._party_nsrv = pn
+        if st.elems_received < self._expected_global_elems(st):
+            return []
+        return self._complete_fsa_round(st, key)
+
+    def _expected_global_elems(self, st) -> int:
+        """FSA countdown target in ELEMENTS, sized from the live
+        membership view at check time: a party whose servers are
+        declared dead stops being counted, so the surviving parties'
+        pushes release the global round. An explicit DMLC_NUM_PARTY
+        stays authoritative (the operator pinned the topology)."""
         if self.cfg.num_parties:
             # explicit count: exact for any mix of party sizes — each
             # party covers the canonical range exactly once per round
             n_parties = self.cfg.num_parties
         else:
-            n_gw = self.po_global.num_workers if self.po_global else 1
+            n_gw = (max(self.po_global.num_live_workers(), 1)
+                    if self.po_global else 1)
             n_parties = max(n_gw // max(self._party_nsrv, 1), 1)
         expected = n_parties
         if self.is_global_server and self.cfg.enable_central_worker:
-            expected += self.po_local.num_workers
-        if st.elems_received < st.length * expected:
-            return []
+            expected += self.po_local.num_live_workers()
+        return st.length * max(expected, 1)
 
+    def _complete_fsa_round(self, st, key) -> List[Action]:
+        """The round-complete tail of :meth:`_global_slice_push` (runs
+        under ``st.lock``); also invoked by :meth:`_on_membership` when
+        an epoch bump shrinks the countdown below what already arrived."""
         # global round complete: run the optimizer (reference: :1305-1319)
         st.rounds += 1
-        st.stored = (self._run_updater(st, (key, rng.offset), st.merged)
+        st.stored = (self._run_updater(st, (key, st.offset), st.merged)
                      if self.updater else
                      np.asarray(st.merged, dtype=st.dtype).ravel())
         st.merged = None
@@ -825,7 +924,8 @@ class KVStoreDistServer:
             # inter-TS: disseminate fresh params through the overlay
             # instead of waiting for party pulls (AutoPullUpdate1/2,
             # kv_app.h:549-659)
-            data, total, o, v = st.stored.copy(), st.total, rng.offset, st.rounds
+            data, total, o, v = (st.stored.copy(), st.total, st.offset,
+                                 st.rounds)
             acts.append(lambda: self.ts_global.offer_model(key, o, total,
                                                            data, v))
         # the global server's OWN local workers (central party) get their
@@ -986,7 +1086,8 @@ class KVStoreDistServer:
                           dtype=st.dtype).ravel()
 
     def _pull_compress_factor(self) -> int:
-        return max(self.po_global.num_workers if self.po_global else 1, 1)
+        return max(self.po_global.num_live_workers()
+                   if self.po_global else 1, 1)
 
     def _push_round_acks(self, st: _KeyState, key: int,
                          reqs) -> List[Action]:
@@ -1341,7 +1442,8 @@ class KVStoreDistServer:
         if self.po_global is None:
             return 1
         spp = max(self.po_local.num_servers, 1)
-        return max(self.po_global.num_workers // spp, 1)
+        n_gw = max(self.po_global.num_live_workers(), 1)
+        return max(n_gw // spp, 1)
 
     @staticmethod
     def _uniq(reqs):
@@ -1513,7 +1615,8 @@ class KVStoreDistServer:
                 # (reference: kvstore_dist_server.h:290-295)
                 with self._lock:
                     self._stops_received += 1
-                    n_gw = self.po_global.num_workers if self.po_global else 0
+                    n_gw = (self.po_global.num_live_workers()
+                            if self.po_global else 0)
                     done = self._stops_received >= max(n_gw, 1)
                 if done:
                     self._stop.set()
@@ -1635,7 +1738,16 @@ class KVStoreDistServer:
             if not hasattr(self, "_gb_reqs"):
                 self._gb_reqs = []
             self._gb_reqs.append((req, srv))
-            if len(self._gb_reqs) < self.po_local.num_workers:
+        self._recheck_global_barrier()
+
+    def _recheck_global_barrier(self) -> None:
+        """Release the cross-party worker barrier if every LIVE local
+        worker has arrived (re-run on membership epoch bumps: a dead
+        worker's barrier request is never coming)."""
+        with self._lock:
+            reqs = getattr(self, "_gb_reqs", None)
+            if (not reqs
+                    or len(reqs) < self._expected_local_pushes()):
                 return
             reqs, self._gb_reqs = self._gb_reqs, []
         if self.po_global is not None:

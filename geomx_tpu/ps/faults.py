@@ -74,6 +74,8 @@ class FaultRule:
     duration_s: float = 0.0    # partition window length
     node: object = "*"         # crash: which van dies
     at: int = 0                # crash: on the Nth matching message (1-based)
+    at_round: int = 0          # crash: at the START of training round N
+                               # (1-based; trainer calls kv.notify_round)
     on: str = "recv"           # crash counter side: "recv" | "send"
     control: bool = False      # also fault control frames
 
@@ -201,6 +203,27 @@ class FaultInjector:
     def _log(self, idx: int, kind: str, src: int, dst: int, seq: int,
              action: str) -> None:
         self.decision_log.append((idx, kind, src, dst, seq, action))
+
+    # -- round-indexed crash (elastic-membership chaos) -------------------
+
+    def on_round(self, round_idx: int) -> None:
+        """Trainer hook (``kv.notify_round``): fire crash rules pinned
+        to a TRAINING ROUND instead of a message count — "kill worker 9
+        at the start of round 3" reads as intended regardless of how
+        many wire messages a round happens to take."""
+        if self._crashed:
+            return
+        for idx, r in enumerate(self.plan.rules):
+            if r.kind != "crash" or r.at_round <= 0:
+                continue
+            if not r.tier_matches(self.van.is_global):
+                continue
+            if not _match(r.node, self.van.my_id):
+                continue
+            if round_idx == r.at_round:
+                self._do_crash(idx, r, self.van.my_id, self.van.my_id,
+                               round_idx)
+                return
 
     # -- send side (crash-at-send counting) ------------------------------
 

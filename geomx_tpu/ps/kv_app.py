@@ -84,6 +84,9 @@ class ReqMeta:
     compr: str
     num_merge: int
     party_nsrv: int = 1
+    # membership epoch the sender stamped; servers fence stale pushes
+    # (van.is_stale) so a declared-dead zombie can't pollute aggregation
+    epoch: int = 0
 
 
 def _pack_kv(meta: Meta, kvs: KVPairs) -> Message:
@@ -312,11 +315,16 @@ class KVWorker:
 
     def request(self, head: int, body: str, recver: int) -> int:
         """SimpleApp-style command (reference: simple_app.h via kv_app.h)."""
-        n = (
-            len(base.expand_group(recver, self.po.num_workers, self.po.num_servers))
-            if base.is_group(recver)
-            else 1
-        )
+        if base.is_group(recver):
+            # the van skips declared-dead members in the group fan-out,
+            # so the expected-response count must match the LIVE set — a
+            # full-group count would wait forever on a corpse's ack
+            dead = self.po.van.declared_dead_ids()
+            n = len([t for t in base.expand_group(
+                recver, self.po.num_workers, self.po.num_servers)
+                if t not in dead]) or 1
+        else:
+            n = 1
         ts = self.customer.new_request(n)
         meta = Meta(
             recver=recver,
@@ -439,6 +447,7 @@ def _req_meta_of(msg: Message) -> ReqMeta:
         compr=msg.meta.compr,
         num_merge=msg.meta.num_merge,
         party_nsrv=msg.meta.party_nsrv,
+        epoch=msg.meta.epoch,
     )
 
 

@@ -61,6 +61,11 @@ class Control(enum.IntEnum):
     ASKPUSH = 9
     REPLY = 10
     AUTOPULLREPLY = 11
+    # membership epoch broadcast: the scheduler promotes a heartbeat
+    # timeout into a cluster-wide declaration. meta.epoch carries the new
+    # epoch, meta.nodes the FULL current dead set (ids), so a lost or
+    # reordered broadcast self-heals on the next one
+    DEAD_NODE = 12
 
 
 class Role(enum.IntEnum):
@@ -188,6 +193,11 @@ class Meta:
     aux_mask: int = 0
     aux_len: int = 0
 
+    # membership epoch: stamped by the van on every non-control send;
+    # servers drop pushes whose sender is declared dead or whose epoch
+    # predates the sender's rejoin (zombie fencing)
+    epoch: int = 0
+
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {}
         for f in dataclasses.fields(self):
@@ -241,7 +251,7 @@ class Meta:
 # version-mismatch ValueError at decode.
 # ---------------------------------------------------------------------------
 
-BINMETA_VERSION = 1
+BINMETA_VERSION = 2
 
 _META_FIELDS: List[Tuple[str, str]] = [
     ("sender", "i"), ("app_id", "i"), ("customer_id", "i"),
@@ -254,7 +264,7 @@ _META_FIELDS: List[Tuple[str, str]] = [
     ("val_bytes", "i"), ("total_bytes", "i"), ("channel", "i"),
     ("tos", "i"), ("val_dtype", "s"), ("dgt_scale", "f"), ("dgt_n", "i"),
     ("lossy", "b"), ("num_merge", "i"), ("party_nsrv", "i"),
-    ("aux_mask", "I"), ("aux_len", "i"),
+    ("aux_mask", "I"), ("aux_len", "i"), ("epoch", "i"),
 ]
 _META_DEFAULTS = {f.name: ([] if isinstance(f.default,
                                             dataclasses._MISSING_TYPE)

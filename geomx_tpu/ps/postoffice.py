@@ -63,6 +63,7 @@ class Postoffice:
             fault_plan=faults.plan_from_config(cfg),
             heartbeat_interval_s=cfg.heartbeat_interval_s,
             heartbeat_timeout_s=cfg.heartbeat_timeout_s,
+            epoch_grace_s=cfg.epoch_grace_s,
             # the priority Sending thread runs in EVERY van (reference:
             # van.cc:548,851) — the party-server→global WAN hop is where
             # ordering matters most (round-2 Weak #6)
@@ -83,6 +84,11 @@ class Postoffice:
         )
         self.van.msg_handler = self._dispatch
         self.van.give_up_handler = self._on_request_undeliverable
+        self.van.on_membership = self._fire_membership
+        # membership listeners: fn(epoch, dead_ids), called off-lock on
+        # every epoch change (kvstore servers re-check aggregation
+        # countdowns; esync prunes its reporter window)
+        self._membership_listeners: List = []
         self._customers: Dict[Tuple[int, int], Customer] = {}
         self._customers_lock = threading.Lock()
         self._started = False
@@ -162,6 +168,36 @@ class Postoffice:
     def server_ids(self) -> List[int]:
         return [base.server_rank_to_id(r) for r in range(self.num_servers)]
 
+    # -- elastic membership ----------------------------------------------
+
+    def add_membership_listener(self, fn) -> None:
+        """Register fn(epoch, dead_ids) for membership epoch changes."""
+        self._membership_listeners.append(fn)
+
+    def _fire_membership(self, epoch: int, dead: frozenset) -> None:
+        for fn in list(self._membership_listeners):
+            try:
+                fn(epoch, dead)
+            except Exception:  # noqa: BLE001 — one listener must not
+                log.exception("membership listener failed")  # starve the rest
+
+    def membership_epoch(self) -> int:
+        return self.van.membership_epoch
+
+    def live_worker_ids(self) -> List[int]:
+        dead = self.van.declared_dead_ids()
+        return [i for i in self.worker_ids() if i not in dead]
+
+    def num_live_workers(self) -> int:
+        return len(self.live_worker_ids())
+
+    def live_server_ids(self) -> List[int]:
+        dead = self.van.declared_dead_ids()
+        return [i for i in self.server_ids() if i not in dead]
+
+    def num_live_servers(self) -> int:
+        return len(self.live_server_ids())
+
     # -- customers -------------------------------------------------------
 
     def register_customer(self, customer: Customer) -> None:
@@ -232,5 +268,12 @@ class Postoffice:
             (i * step, (i + 1) * step if i + 1 < n else max_key) for i in range(n)
         ]
 
-    def num_dead_nodes(self) -> int:
-        return len(self.van.dead_nodes())
+    def num_dead_nodes(self, role: Optional[int] = None) -> int:
+        """Nodes known dead: the declared (epoch) set on every member,
+        plus — on the scheduler — the live heartbeat-lapse scan. ``role``
+        filters to workers or servers (reference:
+        postoffice.h:187 GetDeadNodes(role))."""
+        dead = set(self.van.declared_dead_ids()) | set(self.van.dead_nodes())
+        if role is not None:
+            dead = {i for i in dead if self.van.node_roles.get(i) == role}
+        return len(dead)

@@ -164,6 +164,35 @@ class Resender:
             # sender unreachable (teardown); it will retransmit or give up
             pass
 
+    # -- dead-peer fast fail (elastic membership) ------------------------
+
+    def fail_peer(self, target: int, reason: str = "") -> None:
+        """Fail every pending send to ``target`` NOW. Fired when the
+        scheduler declares the peer dead — without this, each in-flight
+        message to a corpse burns its full PS_RESEND_DEADLINE (or retry
+        budget) before the issuing customer's wait() raises."""
+        reason = reason or f"peer {target} declared dead"
+        gave_up = []
+        with self._lock:
+            for sig, (t, msg, _t0, _due, n) in list(self._outgoing.items()):
+                if t != target:
+                    continue
+                self._outgoing.pop(sig, None)
+                gave_up.append((t, msg, RuntimeError,
+                                f"{reason} ({n} retransmits)"))
+        if gave_up:
+            log.warning("failing %d pending message(s) to dead peer %d",
+                        len(gave_up), target)
+        self._fire_give_ups(gave_up)
+
+    def _fire_give_ups(self, gave_up) -> None:
+        for target, msg, exc, reason in gave_up:
+            if self.on_give_up is not None:
+                try:
+                    self.on_give_up(target, msg, exc, reason)
+                except Exception:  # noqa: BLE001 — monitor must survive
+                    log.exception("on_give_up hook failed")
+
     # -- monitor ---------------------------------------------------------
 
     def _monitor(self) -> None:
@@ -172,9 +201,22 @@ class Resender:
             now = time.monotonic()
             to_resend = []
             gave_up = []
+            # messages registered AFTER the declaration (racing sends)
+            # are caught here each cycle; fail_peer drains the rest at
+            # declaration time
+            ddi = getattr(self.van, "declared_dead_ids", None)
+            dead_peers = ddi() if ddi is not None else frozenset()
             with self._lock:
                 for sig, (target, msg, t0, due,
                           n) in list(self._outgoing.items()):
+                    if target in dead_peers:
+                        self._outgoing.pop(sig, None)
+                        gave_up.append((
+                            target, msg, RuntimeError,
+                            f"peer {target} declared dead (membership "
+                            f"epoch {self.van.membership_epoch}, "
+                            f"{n} retransmits)"))
+                        continue
                     if self.deadline_s > 0 and now - t0 >= self.deadline_s:
                         log.error("abandoning msg sig=%x to %d: no ACK "
                                   "within the %.1fs delivery deadline "
@@ -201,12 +243,7 @@ class Resender:
                     self._outgoing[sig] = (
                         target, msg, t0, now + self._backoff(n + 1), n + 1)
                     to_resend.append((target, msg))
-            for target, msg, exc, reason in gave_up:
-                if self.on_give_up is not None:
-                    try:
-                        self.on_give_up(target, msg, exc, reason)
-                    except Exception:  # noqa: BLE001 — monitor must survive
-                        log.exception("on_give_up hook failed")
+            self._fire_give_ups(gave_up)
             for target, msg in to_resend:
                 self.num_resends += 1
                 try:

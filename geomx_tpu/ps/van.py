@@ -65,6 +65,7 @@ class Van:
         resend_jitter: float = 0.1,
         heartbeat_interval_s: float = 0.0,
         heartbeat_timeout_s: float = 60.0,
+        epoch_grace_s: float = 0.0,
         use_priority_send: bool = False,
         verbose: int = 0,
         dgt: Optional[dict] = None,
@@ -135,7 +136,10 @@ class Van:
         # scheduler rendezvous state
         self._registrations: List[Node] = []
         self._reg_lock = threading.Lock()
-        self._barrier_counts: Dict[int, int] = {}
+        # group -> ids whose barrier request arrived this round; a barrier
+        # releases when every LIVE member of the group has arrived, so a
+        # mid-barrier death cannot wedge the survivors
+        self._barrier_members: Dict[int, set] = {}
 
         # member-side barrier release
         self._barrier_done: Dict[int, threading.Event] = {}
@@ -143,6 +147,25 @@ class Van:
 
         # heartbeat bookkeeping (scheduler side)
         self._heartbeats: Dict[int, float] = {}
+
+        # -- membership epochs ------------------------------------------
+        # The scheduler promotes a heartbeat lapse (after epoch_grace_s of
+        # sustained silence) into a DEAD_NODE broadcast carrying the FULL
+        # dead set plus a bumped epoch; every member mirrors the view
+        # here. Zombie fencing: a push is stale when its sender is in the
+        # dead set, or its epoch predates the sender's rejoin (is_stale).
+        self.epoch_grace_s = epoch_grace_s
+        self.membership_epoch = 0
+        self._member_lock = threading.Lock()
+        self._declared_dead: set = set()
+        # node id -> epoch at which its slot was re-filled; pushes from
+        # the PREVIOUS holder of the id carry an older epoch and are
+        # rejected even after the revival removes the id from the dead set
+        self._rejoin_epoch: Dict[int, int] = {}
+        # owner hook fired (off the member lock) after every epoch change:
+        # on_membership(epoch, dead_ids) — the Postoffice fans it out to
+        # kvstore listeners (aggregation re-checks, esync pruning)
+        self.on_membership: Optional[Callable[[int, frozenset], None]] = None
 
         # upward dispatch: set by Postoffice before start()
         self.msg_handler: Optional[Callable[[Message], None]] = None
@@ -223,6 +246,8 @@ class Van:
             )
         if self.heartbeat_interval_s > 0 and not self.is_scheduler:
             self._spawn(self._heartbeat_loop, "van-heartbeat")
+        if self.heartbeat_interval_s > 0 and self.is_scheduler:
+            self._spawn(self._membership_loop, "van-membership")
 
     def stop(self) -> None:
         log.debug("%s van.stop()", self._tag())
@@ -432,11 +457,20 @@ class Van:
         assert recver > 0, f"invalid recver {recver}"
         msg.meta.sender = self.my_id
         msg.meta.is_global = self.is_global
+        # stamp the current membership epoch on data traffic so receivers
+        # can fence stale senders (zombies / pre-rejoin traffic)
+        if not msg.is_control and msg.meta.epoch == 0:
+            msg.meta.epoch = self.membership_epoch
         targets = (
             base.expand_group(recver, self.num_workers, self.num_servers)
             if base.is_group(recver)
             else [recver]
         )
+        if base.is_group(recver) and self._declared_dead:
+            # group fan-outs skip declared-dead members: a barrier release
+            # or command broadcast must not queue retransmits to a corpse
+            dead = self.declared_dead_ids()
+            targets = [t for t in targets if t not in dead]
         # deliver any self-loopback LAST: a loopback can wake the local
         # waiter (e.g. a barrier release), which may tear the van down
         # while the remaining remote sends are still in flight
@@ -670,6 +704,8 @@ class Van:
             self._process_barrier(msg)
         elif cmd == Control.HEARTBEAT:
             self._heartbeats[msg.meta.sender] = time.monotonic()
+        elif cmd == Control.DEAD_NODE:
+            self._process_dead_node(msg)
         elif cmd == Control.TERMINATE:
             self.stopped.set()
         elif cmd in (Control.ASKPUSH, Control.ASKPULL, Control.REPLY,
@@ -722,6 +758,17 @@ class Van:
                 ):
                     self.my_id = n.id
                     self.is_recovery = n.is_recovery
+            # the table broadcast carries the scheduler's membership
+            # epoch; recovery entries revive their slot (the newcomer is
+            # live, the PREVIOUS holder of the id stays fenced via
+            # _rejoin_epoch)
+            with self._member_lock:
+                if msg.meta.epoch > self.membership_epoch:
+                    self.membership_epoch = msg.meta.epoch
+                for n in msg.meta.nodes:
+                    if n.is_recovery and n.id in self._declared_dead:
+                        self._declared_dead.discard(n.id)
+                        self._rejoin_epoch[n.id] = self.membership_epoch
             if self.my_id != -1:
                 self.ready.set()
 
@@ -741,6 +788,16 @@ class Van:
                         node.is_recovery = True
                         self._registrations[i] = node
                         self._heartbeats.pop(old.id, None)
+                        # revive the slot: bump the epoch BEFORE the table
+                        # broadcast so the rejoined node starts on the new
+                        # epoch while the old holder's in-flight pushes
+                        # stay fenced (_rejoin_epoch)
+                        with self._member_lock:
+                            if old.id in self._declared_dead:
+                                self._declared_dead.discard(old.id)
+                                self.membership_epoch += 1
+                                self._rejoin_epoch[old.id] = \
+                                    self.membership_epoch
                         break
                 else:
                     log.warning("re-registration with no matching dead slot")
@@ -788,11 +845,14 @@ class Van:
                 # dead-node detection starts from "alive", not "unknown"
                 self._heartbeats[n.id] = time.monotonic()
             self.ready.set()
-        # broadcast the table (outside the lock; sends can block)
+        # broadcast the table (outside the lock; sends can block). The
+        # meta carries the membership epoch so a recovering node — which
+        # never saw the DEAD_NODE broadcasts — joins on the current epoch.
         bcast = Message(
             Meta(
                 control_cmd=Control.ADD_GLOBAL_NODE if self.is_global else Control.ADD_NODE,
                 nodes=all_nodes,
+                epoch=self.membership_epoch,
                 is_global=self.is_global,
             )
         )
@@ -809,6 +869,13 @@ class Van:
                 log.warning("failed to send node table to %d: %s", n.id, e)
         if self.on_node_update:
             self.on_node_update(all_nodes)
+        if any(n.is_recovery for n in all_nodes):
+            # propagate the revival (pruned dead set + bumped epoch) to
+            # members that may have missed a table broadcast
+            with self._member_lock:
+                epoch = self.membership_epoch
+                dead_now = frozenset(self._declared_dead)
+            self._broadcast_membership(epoch, dead_now)
 
     # ------------------------------------------------------------------
     # barriers (reference: van.cc:259-288)
@@ -836,32 +903,56 @@ class Van:
             assert self.is_scheduler
             group = msg.meta.barrier_group
             with self._barrier_lock:
-                self._barrier_counts[group] = self._barrier_counts.get(group, 0) + 1
-                expected = len(
-                    base.expand_group(group, self.num_workers, self.num_servers)
-                )
-                done = self._barrier_counts[group] >= expected
-                log.debug("%s barrier req group=%d from=%d count=%d/%d",
-                          self._tag(), group, msg.meta.sender,
-                          self._barrier_counts[group], expected)
-                if done:
-                    self._barrier_counts[group] = 0
-            if done:
-                resp = Message(
-                    Meta(
-                        recver=group,
-                        control_cmd=msg.meta.control_cmd,
-                        barrier_group=group,
-                        request=False,
-                        is_global=self.is_global,
-                    )
-                )
-                self.send(resp)
+                arrived = self._barrier_members.setdefault(group, set())
+                arrived.add(msg.meta.sender)
+            self._maybe_release_barrier(group, msg.meta.control_cmd)
         else:
             with self._barrier_lock:
                 ev = self._barrier_done.get(msg.meta.barrier_group)
             if ev is not None:
                 ev.set()
+
+    def _maybe_release_barrier(self, group: int, control_cmd: int) -> None:
+        """Release ``group`` if every live member's request has arrived.
+
+        Called per arriving request AND on every epoch bump
+        (_recheck_barriers): a member dying mid-barrier shrinks the
+        expected set, which can satisfy an already-pending barrier."""
+        dead = self.declared_dead_ids()
+        with self._barrier_lock:
+            arrived = self._barrier_members.get(group)
+            if not arrived:
+                return
+            expected = [
+                t for t in base.expand_group(group, self.num_workers,
+                                             self.num_servers)
+                if t not in dead
+            ]
+            done = all(t in arrived for t in expected)
+            log.debug("%s barrier group=%d count=%d/%d (dead=%d)",
+                      self._tag(), group, len(arrived), len(expected),
+                      len(dead))
+            if done:
+                self._barrier_members[group] = set()
+        if done:
+            resp = Message(
+                Meta(
+                    recver=group,
+                    control_cmd=control_cmd,
+                    barrier_group=group,
+                    request=False,
+                    is_global=self.is_global,
+                )
+            )
+            self.send(resp)
+
+    def _recheck_barriers(self) -> None:
+        """Epoch bump: re-evaluate every pending barrier round."""
+        cmd = Control.BARRIER_GLOBAL if self.is_global else Control.BARRIER
+        with self._barrier_lock:
+            groups = [g for g, m in self._barrier_members.items() if m]
+        for g in groups:
+            self._maybe_release_barrier(g, cmd)
 
     # ------------------------------------------------------------------
     # heartbeats (reference: van.cc:1128-1140)
@@ -899,6 +990,149 @@ class Van:
             if last is not None and now - last > self.heartbeat_timeout_s:
                 dead.append(nid)
         return dead
+
+    # ------------------------------------------------------------------
+    # membership epochs (scheduler-driven DEAD_NODE broadcasts)
+    # ------------------------------------------------------------------
+
+    def _membership_loop(self) -> None:
+        """Scheduler: promote sustained heartbeat lapses into membership
+        epochs. A node must stay lapsed for ``epoch_grace_s`` beyond its
+        heartbeat timeout before it is declared — a straggler that
+        resumes heartbeating within the grace window is pardoned."""
+        period = max(min(self.heartbeat_interval_s, 1.0), 0.1)
+        suspects: Dict[int, float] = {}
+        while not self.stopped.wait(period):
+            lapsed = set(self.dead_nodes())
+            now = time.monotonic()
+            for nid in list(suspects):
+                if nid not in lapsed:
+                    suspects.pop(nid)  # pardoned: heartbeat resumed
+            newly = []
+            for nid in lapsed:
+                if nid in self._declared_dead:
+                    continue
+                t0 = suspects.setdefault(nid, now)
+                if now - t0 >= self.epoch_grace_s:
+                    newly.append(nid)
+            if newly:
+                for nid in newly:
+                    suspects.pop(nid, None)
+                self.declare_dead(newly)
+
+    def declare_dead(self, ids: List[int]) -> None:
+        """Scheduler: declare ``ids`` dead, bump the epoch, broadcast."""
+        with self._member_lock:
+            fresh = [i for i in ids if i not in self._declared_dead
+                     and i in self.node_table and i != base.SCHEDULER]
+            if not fresh:
+                return
+            self._declared_dead.update(fresh)
+            self.membership_epoch += 1
+            epoch = self.membership_epoch
+            dead = frozenset(self._declared_dead)
+        log.warning("%s membership epoch %d: declaring %s dead (dead set "
+                    "now %s)", self._tag(), epoch, sorted(fresh),
+                    sorted(dead))
+        profiler.instant("membership.declare_dead", cat="membership",
+                         epoch=epoch, dead=sorted(dead))
+        self._broadcast_membership(epoch, dead)
+        self._membership_side_effects(epoch, dead)
+
+    def _broadcast_membership(self, epoch: int, dead: frozenset) -> None:
+        """Send DEAD_NODE (full dead set + epoch) to every live member.
+
+        The full-set encoding makes broadcasts idempotent and
+        self-healing: a member that missed one learns everything from the
+        next. Declared-dead nodes are NOT told — a wrongly-declared
+        zombie keeps stamping the old epoch and stays fenced until it
+        re-registers."""
+        nodes = [Node(role=self.node_roles.get(i, Role.WORKER), id=i)
+                 for i in sorted(dead)]
+        for nid, role in sorted(self.node_roles.items()):
+            if (nid in dead or nid == self.my_id
+                    or role == Role.SCHEDULER):
+                continue
+            m = Message(Meta(
+                recver=nid, sender=self.my_id,
+                control_cmd=Control.DEAD_NODE, nodes=nodes,
+                epoch=epoch, is_global=self.is_global))
+            try:
+                self._send_one(nid, m)
+            except OSError as e:
+                log.warning("%s DEAD_NODE broadcast to %d failed: %s",
+                            self._tag(), nid, e)
+
+    def _process_dead_node(self, msg: Message) -> None:
+        """Member: adopt the scheduler's membership view."""
+        epoch = msg.meta.epoch
+        new_dead = {n.id for n in msg.meta.nodes}
+        with self._member_lock:
+            if epoch < self.membership_epoch:
+                return  # stale broadcast (reordered/retransmitted)
+            if (epoch == self.membership_epoch
+                    and new_dead == self._declared_dead):
+                return  # duplicate: side effects already fired
+            # ids leaving the dead set were revived (slot re-filled):
+            # fence the previous holder's in-flight traffic
+            for nid in self._declared_dead - new_dead:
+                self._rejoin_epoch[nid] = epoch
+            self._declared_dead = set(new_dead)
+            self.membership_epoch = epoch
+            dead = frozenset(new_dead)
+        log.info("%s membership epoch %d: dead set %s", self._tag(),
+                 epoch, sorted(dead))
+        self._membership_side_effects(epoch, dead)
+
+    def _membership_side_effects(self, epoch: int, dead: frozenset) -> None:
+        """Post-epoch-change actions, run OFF the member lock."""
+        r = self._resender
+        if r is not None:
+            for nid in dead:
+                r.fail_peer(nid, f"peer {nid} declared dead "
+                                 f"(membership epoch {epoch})")
+        if self.is_scheduler:
+            self._recheck_barriers()
+        hook = self.on_membership
+        if hook is not None:
+            try:
+                hook(epoch, dead)
+            except Exception:  # noqa: BLE001 — owner hooks must not kill us
+                log.exception("on_membership hook failed")
+
+    def declared_dead_ids(self) -> frozenset:
+        with self._member_lock:
+            return frozenset(self._declared_dead)
+
+    def live_ids(self, role: Optional[int] = None) -> List[int]:
+        """Ids from the node table that are not declared dead, optionally
+        filtered by role (scheduler excluded unless asked for)."""
+        with self._member_lock:
+            dead = set(self._declared_dead)
+        out = []
+        for nid, r in self.node_roles.items():
+            if nid in dead:
+                continue
+            if role is None and r == Role.SCHEDULER:
+                continue
+            if role is not None and r != role:
+                continue
+            out.append(nid)
+        return sorted(out)
+
+    def is_stale(self, sender: int, epoch: int) -> bool:
+        """True when a data message from ``sender`` must be fenced: the
+        sender is declared dead, or its epoch predates the sender id's
+        rejoin (the previous holder of a re-filled slot)."""
+        with self._member_lock:
+            return (sender in self._declared_dead
+                    or epoch < self._rejoin_epoch.get(sender, 0))
+
+    def notify_round(self, round_idx: int) -> None:
+        """Training-round clock for deterministic fault injection
+        (FaultRule.at_round)."""
+        if self._faults is not None:
+            self._faults.on_round(round_idx)
 
     # ------------------------------------------------------------------
 

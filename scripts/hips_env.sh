@@ -61,10 +61,21 @@ launch_hips() {
     env $NH_P DMLC_ROLE=scheduler DMLC_PS_ROOT_URI=$PHOST DMLC_PS_ROOT_PORT=$PPORT \
       DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=2 \
       $PYTHON -c "import geomx_tpu" > /tmp/hips_sched_$PPORT.log 2>&1 &
-    env $(echo $GLOBALS) $NH_P DMLC_ROLE=server \
-      DMLC_PS_ROOT_URI=$PHOST DMLC_PS_ROOT_PORT=$PPORT \
-      DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=2 \
-      $PYTHON -c "import geomx_tpu" > /tmp/hips_server_$PPORT.log 2>&1 &
+    if [ "$PPORT" = "$APORT" ] && [ -n "${CHAOS_PLAN_SERVER_A:-}" ]; then
+      # chaos matrix server-kill case: party A's server (and ONLY it)
+      # runs under its own fault plan — a node/tier match alone cannot
+      # single it out (every party's server is local id 8)
+      env $(echo $GLOBALS) $NH_P DMLC_ROLE=server \
+        DMLC_PS_ROOT_URI=$PHOST DMLC_PS_ROOT_PORT=$PPORT \
+        DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=2 \
+        PS_FAULT_PLAN="$CHAOS_PLAN_SERVER_A" \
+        $PYTHON -c "import geomx_tpu" > /tmp/hips_server_$PPORT.log 2>&1 &
+    else
+      env $(echo $GLOBALS) $NH_P DMLC_ROLE=server \
+        DMLC_PS_ROOT_URI=$PHOST DMLC_PS_ROOT_PORT=$PPORT \
+        DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=2 \
+        $PYTHON -c "import geomx_tpu" > /tmp/hips_server_$PPORT.log 2>&1 &
+    fi
     for w in 0 1; do
       if [ "$PPORT" = "$BPORT" ] && [ "$w" = "1" ]; then
         # last worker runs in the foreground (reference pattern)

@@ -1,15 +1,20 @@
 #!/bin/bash
 # Chaos matrix: the vanilla-HiPS demo (12 processes, 3 parties) run
-# under three representative seeded fault plans. Every random decision
+# under six representative seeded fault plans. Every random decision
 # is drawn from PS_SEED-derived streams (geomx_tpu/ps/faults.py), so a
 # failing case reproduces exactly by re-running with the same seed.
 # The resender is always on: the point of each case is that training
 # still completes despite the injected faults.
 #
 # Cases:
-#   loss       20% data-frame drop on every link
-#   wan-jitter added latency + jitter on half the frames, 5% duplicates
-#   partition  server id 8 cut off from everyone for 3s mid-run
+#   loss        20% data-frame drop on every link
+#   wan-jitter  added latency + jitter on half the frames, 5% duplicates
+#   partition   server id 8 cut off from everyone for 3s mid-run
+#   overlap     pipelined round under drops + reordering + duplicates
+#   worker-kill both data parties' worker 0 crashes at round 3; elastic
+#               membership resizes the round to the survivors
+#   server-kill party A's server crashes mid-round; survivors keep
+#               training and a respawned server restores the snapshot
 #
 # Usage: ./run_chaos_matrix.sh [extra worker args...]
 #   PS_SEED=<n> picks the schedule (default 7).
@@ -30,7 +35,9 @@ run_case() {
     export GPORT=$port_base CPORT=$((port_base + 1)) \
            APORT=$((port_base + 2)) BPORT=$((port_base + 3))
     source ./hips_env.sh
-    launch_hips "$REPO_DIR/examples/cnn.py" --cpu "$@"
+    # || exit 1: a bare `wait` always returns 0, so the subshell's
+    # status must come from the foreground worker itself
+    launch_hips "$REPO_DIR/examples/cnn.py" --cpu "$@" || exit 1
     wait
   )
   if [ $? -eq 0 ]; then
@@ -64,5 +71,53 @@ run_case overlap \
     {"type": "dup", "p": 0.05}]' \
   9790 "$@"
 unset GEOMX_OVERLAP P3_SLICE_BYTES
+
+# elastic membership: both data parties' worker 0 (local id 9) dies at
+# the start of training round 3 (cnn.py's kv.notify_round drives the
+# at_round trigger; the master worker is also local id 9 but exits
+# after init, before any round). Heartbeats declare the corpses dead,
+# each party's server re-sizes the round countdown to the survivors,
+# and the remaining worker per party completes the full run.
+export PS_HEARTBEAT_INTERVAL=1 PS_HEARTBEAT_TIMEOUT=3
+run_case worker-kill \
+  '[{"type": "crash", "node": 9, "at_round": 3, "tier": "local"}]' \
+  9890 "$@"
+unset PS_HEARTBEAT_INTERVAL PS_HEARTBEAT_TIMEOUT
+
+# elastic membership + durable recovery: party A's server crashes on
+# its 50th local data frame (mid-round). Its workers' in-flight rounds
+# fail fast once the declaration lands; party B and the global tier
+# keep training (the FSA countdown re-sizes to the live parties); a
+# replacement server then takes the dead slot (is_recovery) and
+# restores party A's state from the snapshot.
+echo "=== chaos[server-kill] seed=$SEED ==="
+(
+  export PS_SEED=$SEED
+  export PS_RESEND=1 PS_RESEND_TIMEOUT=500 PS_RESEND_DEADLINE=120
+  export PS_HEARTBEAT_INTERVAL=1 PS_HEARTBEAT_TIMEOUT=3
+  export PS_SNAPSHOT_DIR=$(mktemp -d) PS_SNAPSHOT_INTERVAL=1
+  # scoped via hips_env.sh so ONLY party A's server runs this plan — a
+  # node/tier match alone also hits party B's server and the global
+  # servers' local role (all are local id 8)
+  export CHAOS_PLAN_SERVER_A='[{"type": "crash", "node": 8, "at": 50, "on": "recv", "tier": "local"}]'
+  export GPORT=9990 CPORT=9991 APORT=9992 BPORT=9993
+  source ./hips_env.sh
+  # replacement party-A server: registers after the crash has been
+  # declared, is handed the dead slot and restores the snapshot
+  ( sleep 20
+    env $(echo $GLOBALS) DMLC_ROLE=server \
+      DMLC_PS_ROOT_URI=$HOST_A DMLC_PS_ROOT_PORT=$APORT \
+      DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=2 \
+      $PYTHON -c "import geomx_tpu" > /tmp/hips_server_A_respawn.log 2>&1
+  ) &
+  launch_hips "$REPO_DIR/examples/cnn.py" --cpu "$@" || exit 1
+  wait
+)
+if [ $? -eq 0 ]; then
+  echo "=== chaos[server-kill] OK ==="
+else
+  echo "=== chaos[server-kill] FAILED (re-run with PS_SEED=$SEED to reproduce) ==="
+  FAILED=1
+fi
 
 exit $FAILED

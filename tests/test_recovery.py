@@ -40,10 +40,11 @@ class SingleTier:
     plans, resend knobs...) so robustness tests configure the whole tier
     the way a launch script would via environment variables."""
 
-    def __init__(self, extra=None, num_servers=1):
+    def __init__(self, extra=None, num_servers=1, num_workers=2):
         self.port = free_port()
         self.extra = dict(extra or {})
         self.num_servers = num_servers
+        self.num_workers = num_workers
         self.threads = []
         self.errors = []
         self.sched_po = None
@@ -64,7 +65,8 @@ class SingleTier:
 
     def _cfg(self, **kw):
         base = dict(ps_root_uri="127.0.0.1", ps_root_port=self.port,
-                    num_workers=2, num_servers=self.num_servers, **HB)
+                    num_workers=self.num_workers,
+                    num_servers=self.num_servers, **HB)
         base.update(self.extra)
         base.update(kw)
         return Config(**base)
@@ -75,7 +77,7 @@ class SingleTier:
         self.sched_po = Postoffice(
             my_role=Role.SCHEDULER, is_global=False,
             root_uri="127.0.0.1", root_port=self.port,
-            num_workers=2, num_servers=self.num_servers,
+            num_workers=self.num_workers, num_servers=self.num_servers,
             cfg=Config(**sched_cfg))
 
         def sched():
@@ -90,8 +92,8 @@ class SingleTier:
         self.server = self.servers[0]
         for s in self.servers:
             self._run(s.run)
-        boxes = [[], []]
-        for i in range(2):
+        boxes = [[] for _ in range(self.num_workers)]
+        for i in range(self.num_workers):
             self._run(lambda b=boxes[i]: b.append(
                 KVStoreDist(cfg=self._cfg(role="worker"))))
         for _ in range(300):
