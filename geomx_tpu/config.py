@@ -189,6 +189,11 @@ class Config:
     # re-issued up to this many times before its give-up error surfaces
     # through the RoundFuture; 0 = no retries (the old behavior)
     chunk_retries: int = 0              # PS_CHUNK_RETRIES
+    # runtime wire sanitizer (ps/sanitizer.py): every van checks
+    # request/ack pairing, countdown leaks, epoch monotonicity and
+    # sends-to-dead on its own traffic, and reports at stop(); the
+    # dynamic dual of the GX-P3xx protocol pass. Test/chaos-matrix aid
+    wire_sanitizer: bool = False        # GEOMX_WIRE_SANITIZER
     verbose: int = 0                    # PS_VERBOSE
     # round-4 verdict item 2: the reference makes its transport deadlines
     # env-tunable (van.cc:527-533 PS_RESEND_TIMEOUT / heartbeat envs);
@@ -295,6 +300,7 @@ def load() -> Config:
         replicate=env_bool("PS_REPLICATE", True),
         epoch_grace_s=env_float("PS_EPOCH_GRACE", 0.0),
         chunk_retries=env_int("PS_CHUNK_RETRIES", 0),
+        wire_sanitizer=env_bool("GEOMX_WIRE_SANITIZER"),
         verbose=env_int("PS_VERBOSE", 0),
         barrier_timeout_s=env_float("PS_BARRIER_TIMEOUT", 600.0),
         op_timeout_s=env_float("PS_OP_TIMEOUT", 300.0),

@@ -89,8 +89,10 @@ class KVStoreDist(KVStore):
         if c.enable_intra_ts:
             from geomx_tpu.ps.tsengine import TSNode
 
+            # live view, not the static worker count: a peer that dies
+            # mid-round must shrink the merge target (GX-P305)
             self._ts = TSNode(self.po, self.kvw,
-                              tgt_merge=self.po.num_workers,
+                              tgt_merge=self.po.num_live_workers,
                               final_push=self._ts_final_push)
             self._ts.on_push_sent = lambda _k, _o, _v: self._untrack(_k)
             self.kvw.set_request_handle(

@@ -121,6 +121,11 @@ class _BatchResponder:
         self._parts: List[KVPairs] = []
         self._lock = threading.Lock()
 
+    # this proxy only merges parts into its own buffer; it exists and
+    # runs exclusively behind the constructing handler's is_stale fence
+    # (_handle_data checks before building one), so the per-class fence
+    # closure cannot see it.
+    # geomx-lint: disable=GX-P304
     def response(self, req, kvs: Optional[KVPairs] = None,
                  body: str = "") -> None:
         with self._lock:
@@ -333,8 +338,11 @@ class KVStoreDistServer:
             from geomx_tpu.ps.tsengine import TSNode
 
             self._ts_kvw_local = KVWorker(self.po_local, customer_id=1)
+            # live view, not the static worker count: a contributor that
+            # dies mid-round must shrink the merge target or the round
+            # never reaches tgt (GX-P305)
             self.ts_local = TSNode(self.po_local, self._ts_kvw_local,
-                                   tgt_merge=self.po_local.num_workers)
+                                   tgt_merge=self.po_local.num_live_workers)
         # startup barrier, local tier (reference: kvstore_dist.h:246);
         # a recovering server skips it — survivors won't re-join
         # (reference: kvstore_dist.h:63 via is_recovery)
@@ -363,7 +371,7 @@ class KVStoreDistServer:
                                                    customer_id=1)
                     self.ts_global = TSNode(
                         self.po_global, self._ts_kvw_global,
-                        tgt_merge=self._num_parties())
+                        tgt_merge=self._num_parties)
             else:
                 self.worker_global = KVWorker(self.po_global)
                 if self.cfg.enable_inter_ts:
@@ -371,7 +379,7 @@ class KVStoreDistServer:
 
                     self.ts_global = TSNode(
                         self.po_global, self.worker_global,
-                        tgt_merge=self._num_parties(),
+                        tgt_merge=self._num_parties,
                         final_push=self._ts_global_final_push)
                     # TS relay/model hops first; everything else falls
                     # through to the command handler
@@ -960,7 +968,7 @@ class KVStoreDistServer:
                            req_compr, aux=None) -> List[Action]:
         with self._lock:
             total = total or self._key_total.get(key, 0)
-        acts: List[Action] = []
+        overlapping = []
         for rng in self._canonical_ranges(key, total):
             req_lo = off
             if req_compr == "rsp":
@@ -969,6 +977,23 @@ class KVStoreDistServer:
                 req_hi = off + (length or rng.length + rng.offset - off)
             if req_hi <= rng.offset or req_lo >= rng.offset + rng.length:
                 continue
+            overlapping.append(rng)
+        if not overlapping:
+            # a pull outside every canonical range must still be ACKED:
+            # silently dropping it parks the requester until its op
+            # timeout (the zero-iteration drop GX-P302's lexical pass
+            # cannot see — kept fixed by test_pull_missed_range_acks)
+            log.warning("pull of key %d [%d:+%d] overlaps no canonical "
+                        "range; acking empty", key, off, length or 0)
+            return [lambda: srv.response(req)]
+        if len(overlapping) > 1:
+            # one request gets ONE response: merge the per-range parts
+            # exactly like multi-key requests do (the transport tracker
+            # fires on the first response, so a second would be lost —
+            # and the wire sanitizer counts it as a double ack)
+            srv = _BatchResponder(srv, len(overlapping))
+        acts: List[Action] = []
+        for rng in overlapping:
             st = self._state(key, rng.offset)
             with st.lock:
                 if not st.initialized:
@@ -1607,6 +1632,17 @@ class KVStoreDistServer:
 
     def _handle_command(self, req: ReqMeta, srv: KVServer,
                         global_tier: bool) -> None:
+        van = (self.po_global.van
+               if global_tier and self.po_global is not None
+               else self.po_local.van)
+        if van.is_stale(req.sender, req.epoch):
+            # zombie/pre-rejoin command: drop WITHOUT ack, mirroring
+            # _handle_data's fence. A dead worker's STOP_SERVER must not
+            # tick the stop countdown, and its GLOBAL_BARRIER entry
+            # would count a worker that is never coming back.
+            log.warning("dropping stale command %d from %d (epoch %d)",
+                        req.head, req.sender, req.epoch)
+            return
         head, body = req.head, req.body
         if head == Command.STOP_SERVER:
             srv.response(req)

@@ -69,6 +69,8 @@ class Postoffice:
             # ordering matters most (round-2 Weak #6)
             use_priority_send=cfg.enable_p3,
             verbose=cfg.verbose,
+            # GEOMX_WIRE_SANITIZER: per-van protocol-invariant checking
+            wire_sanitizer=cfg.wire_sanitizer,
             # DGT runs on the inter-DC (global) tier only (reference:
             # StartGlobal binds the UDP channels, van.cc:613-646)
             dgt={

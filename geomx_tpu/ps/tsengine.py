@@ -157,7 +157,12 @@ class TSScheduler:
             self._prune(self._pull_rounds, key, off, ver)
             served = self._pull_rounds.setdefault((key, off, ver), set())
             cands = [base.worker_rank_to_id(r) for r in range(self.num_workers)]
-            cands = [c for c in cands if c != sender and c not in served]
+            # never disseminate toward a declared-dead worker: the model
+            # hop would park in the resender against a corpse and the
+            # round's multicast tree stalls on the give-up timeout
+            dead = self.van.declared_dead_ids()
+            cands = [c for c in cands if c != sender and c not in served
+                     and c not in dead]
             if not cands:
                 # keep the completed round's served-set until _prune drops
                 # it: senders re-ask from their ack callbacks, and popping
@@ -220,11 +225,15 @@ class TSNode:
     request handle (reference: kvstore_dist.h:58 WorkersMerge binding).
     """
 
-    def __init__(self, po, kvw, *, tgt_merge: int,
+    def __init__(self, po, kvw, *, tgt_merge,
                  final_push: Optional[Callable] = None):
         self.po = po
         self.kvw = kvw
-        self.tgt = max(tgt_merge, 1)
+        # int OR zero-arg callable (e.g. po.num_live_workers): a static
+        # count frozen at construction can never be satisfied once a
+        # contributor dies mid-round (GX-P305), so owners pass the live
+        # view and `tgt` re-evaluates per ask
+        self._tgt_merge = tgt_merge
         # final_push(key, off, total, arr, num_merge, ver): deliver the
         # fully-merged gradient to the server tier (normal sharded push)
         self.final_push = final_push
@@ -239,6 +248,12 @@ class TSNode:
         # through final_push's own acks instead
         self.on_push_sent: Optional[Callable[[int, int, int], None]] = None
         po.attach_ts(self)
+
+    @property
+    def tgt(self) -> int:
+        t = self._tgt_merge() if callable(self._tgt_merge) \
+            else self._tgt_merge
+        return max(int(t), 1)
 
     # ------------------------------------------------------------------
     # push side (reference: ZPush TS branch kv_app.h:234-246)
@@ -315,6 +330,14 @@ class TSNode:
         """Route DATA_TS_* requests; returns False if not TS traffic."""
         if req.simple_app or not req.push:
             return False
+        if req.head in (DATA_TS_RELAY, DATA_TS_MODEL) \
+                and self.po.van.is_stale(req.sender, req.epoch):
+            # zombie/pre-rejoin hop: drop WITHOUT ack (same fence as the
+            # server's _handle_data) so a dead peer's relay cannot be
+            # merged into a live round's slot countdown
+            log.warning("TS: dropping stale hop from %d (epoch %d)",
+                        req.sender, req.epoch)
+            return True
         if req.head == DATA_TS_RELAY:
             for i, key in enumerate(kvs.keys):
                 off = kvs.offset_of(i)

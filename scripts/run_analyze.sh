@@ -1,5 +1,6 @@
 #!/bin/bash
-# geomx-lint from any cwd: lock, traced-code and config-drift analysis.
+# geomx-lint from any cwd, all four passes: lock, traced-code,
+# config-drift and wire-protocol (GX-P3xx) analysis.
 # Flags pass through, e.g.:  scripts/run_analyze.sh --passes traced --json
 # See docs/static-analysis.md for the rule catalogue + baseline workflow.
 set -euo pipefail

@@ -1,0 +1,111 @@
+"""Seeded GX-P3xx violations (protocol pass) with clean counterparts.
+
+Each bad site is annotated with the rule it must trip; the GoodServer
+mirror shows the fenced/range-aware/live-view versions that must stay
+clean. tests/test_analyze.py asserts the exact finding set.
+"""
+
+
+class Control:
+    EMPTY = 0    # exempt: the data-frame marker, never a stamped verb
+    PING = 1     # sent AND dispatched: clean
+    ORPHAN = 2   # GX-P301 sent-unhandled
+    GHOST = 3    # GX-P301 dispatched-unsent
+    UNUSED = 4   # GX-P301 unused
+
+
+class Meta:
+    def __init__(self, control_cmd=Control.EMPTY):
+        self.control_cmd = control_cmd
+
+
+def send_ping(van):
+    van.send(Meta(control_cmd=Control.PING))
+
+
+def send_orphan(van):
+    van.send(Meta(control_cmd=Control.ORPHAN))
+
+
+def dispatch(cmd, van):
+    if cmd == Control.PING:
+        van.pong()
+    elif cmd in (Control.GHOST,):
+        van.spook()
+
+
+class BadServer:
+    def __init__(self, van):
+        self.van = van
+        self.nm = 0
+        self.pending = {}
+
+    def handle_push(self, req):
+        if req.head < 0:
+            return None          # GX-P302: silent drop, no ack path
+        self.nm += 1             # GX-P304: unfenced countdown mutation
+        self.van.respond(req)
+
+    def handle_pull(self, req):
+        for k in req.keys:       # GX-P303: routes by bare key, no
+            self.pending[k] = 1  # offset — sliced keys alias one slot
+        self.van.respond(req)
+
+    def check_round(self, received):
+        # GX-P305 (compare): arrival count vs static membership
+        if received >= self.van.num_workers:
+            self.flush()
+
+    def start_round(self):
+        # GX-P305 (kwarg): countdown target sized from static count
+        self.countdown(tgt=self.van.num_workers)
+
+    def flush(self):
+        self.nm = 0
+
+    def countdown(self, tgt):
+        self.nm = tgt
+
+
+class GoodServer:
+    def __init__(self, van):
+        self.van = van
+        self.nm = 0
+        self.pending = {}
+
+    def handle_push(self, req):
+        if self.van.is_stale(req.sender, req.epoch):
+            return               # fenced drop: the one legal no-ack exit
+        self.nm += 1             # fenced mutation: clean
+        self.van.respond(req)
+
+    def handle_pull(self, req):
+        for k in req.keys:
+            off = self.offset_of(k, req.ranges)
+            self.pending[(k, off)] = 1   # (key, range) routing: clean
+        self.van.respond(req)
+
+    def handle_other(self, req):
+        if req.head != 7:
+            return False         # handler-chain decline: clean
+        self.van.respond(req)
+        return True
+
+    def check_round(self, received):
+        if received >= self.van.num_live_workers():  # live view: clean
+            self.flush()
+
+    def flush(self):
+        self.nm = 0
+
+    def offset_of(self, key, ranges):
+        return ranges.get(key, 0)
+
+
+# GX-P306: the committed protoproj lock holds version 3 with a WRONG
+# fingerprint for these fields -> schema-changed fires.
+BINMETA_VERSION = 3
+
+_META_FIELDS = [
+    ("sender", "i"), ("timestamp", "i"), ("request", "b"),
+]

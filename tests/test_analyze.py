@@ -23,8 +23,11 @@ import pytest
 
 from tools.analyze import (DEFAULT_BASELINE, load_baseline, load_sources,
                            run_all, run_concurrency, run_config_drift,
-                           run_traced, save_baseline, split_by_baseline)
+                           run_protocol, run_traced, save_baseline,
+                           split_by_baseline, write_binmeta_lock)
 from tools.analyze.config_drift import _expand_doc_shorthand
+from tools.analyze.protocol import (binmeta_lock_path, extract_meta_schema,
+                                    meta_schema_fingerprint)
 
 REPO = Path(__file__).resolve().parents[1]
 FIXTURES = Path(__file__).resolve().parent / "fixtures_analyze"
@@ -161,6 +164,97 @@ def test_doc_shorthand_expansion():
 
 
 # ---------------------------------------------------------------------------
+# protocol pass (GX-P301..P306)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def proto_findings():
+    root = FIXTURES / "protoproj"
+    sources = load_sources([root / "geomx_tpu"], root)
+    return run_protocol(sources, root)
+
+
+def test_control_verb_drift_fires(proto_findings):
+    hits = _by_rule(proto_findings, "GX-P301")
+    got = {(h.symbol, h.detail) for h in hits}
+    assert got == {("Control.ORPHAN", "sent-unhandled"),
+                   ("Control.GHOST", "dispatched-unsent"),
+                   ("Control.UNUSED", "unused")}
+    # PING (sent + dispatched) and EMPTY (exempt marker) stay clean
+
+
+def test_droppable_request_fires(proto_findings):
+    hits = _by_rule(proto_findings, "GX-P302")
+    assert [h.symbol for h in hits] == ["BadServer.handle_push"]
+    assert hits[0].detail.startswith("return@")
+    # the fenced drop, the `return False` decline and the post-ack
+    # return in GoodServer all stay clean
+
+
+def test_bare_key_routing_fires(proto_findings):
+    hits = _by_rule(proto_findings, "GX-P303")
+    assert [h.symbol for h in hits] == ["BadServer.handle_pull"]
+    # GoodServer.handle_pull consults offset_of — clean
+
+
+def test_unfenced_mutation_fires(proto_findings):
+    hits = _by_rule(proto_findings, "GX-P304")
+    assert [h.symbol for h in hits] == ["BadServer.handle_push"]
+    assert hits[0].detail == "unfenced-mutation"
+    # GoodServer.handle_push mutates behind its is_stale fence — clean
+
+
+def test_static_count_fires(proto_findings):
+    hits = _by_rule(proto_findings, "GX-P305")
+    got = {(h.symbol, h.detail) for h in hits}
+    assert got == {("BadServer.check_round", "compare:num_workers"),
+                   ("BadServer.start_round", "kwarg:tgt:num_workers")}
+    # GoodServer.check_round uses num_live_workers() — clean
+
+
+def test_binmeta_schema_drift_fires(proto_findings):
+    hits = _by_rule(proto_findings, "GX-P306")
+    assert [h.detail for h in hits] == ["schema-changed"]
+    assert hits[0].symbol == "_META_FIELDS"
+
+
+def test_binmeta_lock_missing_and_version_change(tmp_path):
+    src = FIXTURES / "protoproj" / "geomx_tpu" / "proto_bad.py"
+    (tmp_path / "geomx_tpu").mkdir()
+    fx = tmp_path / "geomx_tpu" / "proto_bad.py"
+    fx.write_text(src.read_text(encoding="utf-8"), encoding="utf-8")
+    sources = load_sources([tmp_path / "geomx_tpu"], tmp_path)
+
+    # no lock at all -> lock-missing
+    hits = _by_rule(run_protocol(sources, tmp_path), "GX-P306")
+    assert [h.detail for h in hits] == ["lock-missing"]
+
+    # a fresh lock makes the pass clean
+    write_binmeta_lock(sources, tmp_path)
+    assert _by_rule(run_protocol(sources, tmp_path), "GX-P306") == []
+
+    # bump BINMETA_VERSION without refreshing the lock -> version-changed
+    fx.write_text(fx.read_text(encoding="utf-8").replace(
+        "BINMETA_VERSION = 3", "BINMETA_VERSION = 4"), encoding="utf-8")
+    sources = load_sources([tmp_path / "geomx_tpu"], tmp_path)
+    hits = _by_rule(run_protocol(sources, tmp_path), "GX-P306")
+    assert [h.detail for h in hits] == ["version-changed"]
+
+
+def test_committed_binmeta_lock_matches_tree():
+    """The real lock is in sync with geomx_tpu/ps/message.py — the
+    schema-drift gate holds on the committed tree."""
+    import json
+    sources = load_sources([REPO / "geomx_tpu" / "ps" / "message.py"], REPO)
+    schema = extract_meta_schema(sources)
+    assert schema is not None
+    _src, _line, version, fields = schema
+    lock = json.loads(binmeta_lock_path(REPO).read_text(encoding="utf-8"))
+    assert lock["version"] == version
+    assert lock["fingerprint"] == meta_schema_fingerprint(fields)
+
+
+# ---------------------------------------------------------------------------
 # plumbing: syntax errors, suppression, baseline
 # ---------------------------------------------------------------------------
 
@@ -207,6 +301,84 @@ def test_suppression_comment_drops_finding(tmp_path):
                                        passes=["concurrency"]))
 
 
+_MULTILINE = textwrap.dedent("""\
+    class Counter:
+        def __init__(self, po):
+            self.po = po
+
+        def arm(self, received):
+            {before}self.check(
+                received,{inline}
+                tgt=self.po.num_workers,
+            )
+
+        def check(self, received, tgt):
+            return received >= tgt
+    """)
+
+
+def test_suppression_spans_multiline_statement(tmp_path):
+    """A disable comment anywhere on a multi-line statement — or on the
+    line above it — suppresses a finding anchored inside it."""
+    f = tmp_path / "span.py"
+
+    f.write_text(_MULTILINE.format(before="", inline=""), encoding="utf-8")
+    assert "GX-P305" in _rules(run_all([f], tmp_path, passes=["protocol"]))
+
+    # comment on a DIFFERENT line of the same statement than the finding
+    f.write_text(
+        _MULTILINE.format(
+            before="", inline="  # geomx-lint: disable=GX-P305"),
+        encoding="utf-8")
+    assert _by_rule(run_all([f], tmp_path, passes=["protocol"]),
+                    "GX-P305") == []
+
+    # comment on the line above the statement's first line
+    f.write_text(
+        _MULTILINE.format(
+            before="# geomx-lint: disable=GX-P305\n        ", inline=""),
+        encoding="utf-8")
+    assert _by_rule(run_all([f], tmp_path, passes=["protocol"]),
+                    "GX-P305") == []
+
+
+_DECORATED = textwrap.dedent("""\
+    import functools
+
+    class S:
+        def __init__(self, po):
+            self.po = po
+            self.nm = 0
+
+        {comment}@functools.lru_cache(None)
+        def handle_push(self, req):
+            self.nm += 1
+            self.po.respond(req)
+    """)
+
+
+def test_suppression_spans_decorated_def(tmp_path):
+    """A disable comment above the decorator suppresses a finding
+    anchored at the def line; a body comment must NOT (header-only
+    span)."""
+    f = tmp_path / "deco.py"
+
+    f.write_text(_DECORATED.format(comment=""), encoding="utf-8")
+    assert "GX-P304" in _rules(run_all([f], tmp_path, passes=["protocol"]))
+
+    f.write_text(
+        _DECORATED.format(comment="# geomx-lint: disable=GX-P304\n    "),
+        encoding="utf-8")
+    assert _by_rule(run_all([f], tmp_path, passes=["protocol"]),
+                    "GX-P304") == []
+
+    # a comment in the BODY is outside the header span — still fires
+    body = _DECORATED.format(comment="").replace(
+        "self.nm += 1", "self.nm += 1  # geomx-lint: disable=GX-P304")
+    f.write_text(body, encoding="utf-8")
+    assert "GX-P304" in _rules(run_all([f], tmp_path, passes=["protocol"]))
+
+
 def test_baseline_roundtrip_and_split(tmp_path, lock_findings):
     bl = tmp_path / "baseline.json"
     save_baseline(bl, lock_findings)
@@ -218,6 +390,43 @@ def test_baseline_roundtrip_and_split(tmp_path, lock_findings):
     moved = accepted[0].__class__(**{**vars(accepted[0]),
                                      "line": accepted[0].line + 40})
     assert moved.fingerprint in baseline
+
+
+def test_prune_baseline_drops_only_stale(tmp_path, lock_findings):
+    """`--prune-baseline` removes fingerprints no finding produces and
+    keeps the live ones."""
+    import json
+    bl = tmp_path / "baseline.json"
+    save_baseline(bl, lock_findings)
+    live = sorted(load_baseline(bl))
+    stale = ["GX-L999:gone.py:nowhere:", "GX-L998:gone.py:also:"]
+    bl.write_text(json.dumps({"version": 1,
+                              "findings": sorted(live + stale)}) + "\n",
+                  encoding="utf-8")
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--prune-baseline",
+         "--root", str(FIXTURES), "--baseline", str(bl),
+         str(FIXTURES / "locks_bad.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "2 stale entrie(s) dropped" in proc.stdout
+    assert sorted(load_baseline(bl)) == live
+
+
+def test_prune_committed_baseline_is_noop(tmp_path):
+    """Pruning a copy of the committed baseline changes nothing — the
+    repo baseline carries no stale entries."""
+    bl = tmp_path / "baseline.json"
+    bl.write_text(DEFAULT_BASELINE.read_text(encoding="utf-8"),
+                  encoding="utf-8")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--prune-baseline",
+         "--baseline", str(bl)],
+        cwd=REPO, capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 stale entrie(s) dropped" in proc.stdout
+    assert load_baseline(bl) == load_baseline(DEFAULT_BASELINE)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,152 @@
+"""Regression tests for the GX-P3xx protocol fixes in kvstore/server.py.
+
+These pin the genuine findings the protocol pass (tools/analyze/
+protocol.py) surfaced and this PR fixed:
+
+- a stale (zombie/pre-rejoin) command must be fence-dropped before it
+  ticks the STOP_SERVER countdown or enrolls in the global barrier
+  (GX-P304 on `_handle_command` / `_handle_global_barrier`);
+- the global-server stop countdown completes against the LIVE worker
+  count, not the static topology (GX-P305);
+- `_pull_global_store` acks a pull that overlaps no canonical range
+  instead of silently dropping it, and merges a multi-range overlap
+  into ONE wire response (the zero-iteration / double-ack holes GX-P302
+  documents as its lexical blind spot).
+
+All units: the server object is built via ``__new__`` with only the
+state each path touches, so no sockets or jax are involved.
+"""
+
+import threading
+import types
+
+import numpy as np
+import pytest
+
+from geomx_tpu.kvstore.base import Command
+from geomx_tpu.kvstore.server import KVStoreDistServer
+from geomx_tpu.kvstore.sharding import Shard
+
+
+class StubVan:
+    def __init__(self, stale=False):
+        self.stale = stale
+
+    def is_stale(self, sender, epoch):
+        return self.stale
+
+
+class RecordingApp:
+    def __init__(self):
+        self.responses = []
+
+    def response(self, req, kvs=None, body=""):
+        self.responses.append((req, kvs, body))
+
+
+def command_req(head, sender=9, epoch=1, body=""):
+    return types.SimpleNamespace(head=head, sender=sender, epoch=epoch,
+                                 body=body)
+
+
+def make_server(*, stale=False, live_workers=2):
+    s = KVStoreDistServer.__new__(KVStoreDistServer)
+    s.po_local = types.SimpleNamespace(van=StubVan(stale))
+    s.po_global = types.SimpleNamespace(
+        van=StubVan(stale), num_live_workers=lambda: live_workers)
+    s.is_global_server = True
+    s._lock = threading.Lock()
+    s._stops_received = 0
+    s._stop = threading.Event()
+    return s
+
+
+def test_stale_stop_server_does_not_tick_countdown():
+    s = make_server(stale=True)
+    app = RecordingApp()
+    s._handle_command(command_req(Command.STOP_SERVER), app, True)
+    # fence-dropped: no ack, no countdown tick, no stop
+    assert app.responses == []
+    assert s._stops_received == 0
+    assert not s._stop.is_set()
+
+
+def test_stale_global_barrier_not_enrolled():
+    s = make_server(stale=True)
+    app = RecordingApp()
+    s._handle_command(command_req(Command.GLOBAL_BARRIER), app, True)
+    assert app.responses == []
+    assert not hasattr(s, "_gb_reqs")
+
+
+def test_stop_countdown_sized_from_live_view():
+    """3 static global workers, 1 dead: the stop gate must close after
+    the 2 LIVE stops (the static count would park forever)."""
+    s = make_server(stale=False, live_workers=2)
+    app = RecordingApp()
+    s._handle_command(command_req(Command.STOP_SERVER, sender=9), app, True)
+    assert not s._stop.is_set()
+    s._handle_command(command_req(Command.STOP_SERVER, sender=11), app, True)
+    assert s._stop.is_set()
+    assert len(app.responses) == 2  # every live stop is acked
+
+
+def make_pull_server():
+    s = KVStoreDistServer.__new__(KVStoreDistServer)
+    s._lock = threading.Lock()
+    s._key_total = {}
+    s._states = {}
+    s.po_local = None
+    s.po_global = types.SimpleNamespace(my_rank=0, num_servers=1)
+    s.cfg = types.SimpleNamespace(bigarray_bound=1 << 20)
+    return s
+
+
+def test_pull_missed_range_acks():
+    """A pull overlapping no canonical range still acks (empty) — the
+    requester must not park until its op timeout."""
+    s = make_pull_server()
+    app = RecordingApp()
+    req = types.SimpleNamespace(push=False, pull=True)
+    acts = s._pull_global_store(req, app, 3, 100, 4, 8, "")
+    assert len(acts) == 1
+    acts[0]()
+    assert len(app.responses) == 1
+    got_req, kvs, _ = app.responses[0]
+    assert got_req is req and kvs is None  # bare empty ack
+
+
+def test_pull_multi_range_merges_to_one_response():
+    """Two canonical ranges overlapped by one pull produce ONE merged
+    wire response (a second response to the same timestamp is lost by
+    the tracker and flagged by the wire sanitizer)."""
+    s = make_pull_server()
+    # force the defensive multi-range shape (assign() itself gives one
+    # shard per rank): rank 0 owns both halves of key 3
+    s._canonical_ranges = lambda key, total: [Shard(0, 0, 4, 8),
+                                              Shard(0, 4, 4, 8)]
+    for off in (0, 4):
+        st = s._state(3, off)
+        st.initialized = True
+        st.offset = off
+        st.length = 4
+        st.total = 8
+        st.stored = np.arange(off, off + 4, dtype=np.float32)
+    app = RecordingApp()
+    req = types.SimpleNamespace(push=False, pull=True)
+    acts = s._pull_global_store(req, app, 3, 0, 8, 8, "")
+    assert len(acts) == 2
+    for a in acts:
+        a()
+    assert len(app.responses) == 1  # ONE merged response, not two
+    _, kvs, _ = app.responses[0]
+    assert list(kvs.keys) == [3, 3]
+    assert [kvs.offset_of(i) for i in range(2)] == [0, 4]
+    np.testing.assert_allclose(np.concatenate(kvs.vals),
+                               np.arange(8, dtype=np.float32))
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))

@@ -9,6 +9,7 @@ geomx_tpu/ps/tsengine.py.
 
 import json
 import threading
+import types
 
 import numpy as np
 import pytest
@@ -28,11 +29,15 @@ from test_hips import Topology, _parallel, free_port
 class FakeVan:
     is_global = False
 
-    def __init__(self):
+    def __init__(self, dead=()):
         self.sent = []
+        self.dead = set(dead)
 
     def send(self, msg):
         self.sent.append(msg)
+
+    def declared_dead_ids(self):
+        return frozenset(self.dead)
 
 
 def _ask(sched, cmd, sender, **body):
@@ -108,6 +113,60 @@ def test_scheduler_pull_excludes_holder():
     _ask(sched, Control.ASKPULL, holder, key=1, off=0, ver=1)
     [(_, d)] = _replies(van)
     assert d["dest"] == psbase.worker_rank_to_id(1)
+
+
+def test_scheduler_pull_skips_declared_dead():
+    """Dissemination never targets a declared-dead worker: the model hop
+    would park in the resender against a corpse (GX-P3xx fix)."""
+    dead = psbase.worker_rank_to_id(1)
+    van = FakeVan(dead={dead})
+    sched = TSScheduler(van, num_workers=2, greed_rate=0.0)
+    server = psbase.server_rank_to_id(0)
+    _ask(sched, Control.ASKPULL, server, key=2, off=0, ver=1)
+    [(_, d)] = _replies(van)
+    assert d["dest"] == psbase.worker_rank_to_id(0)
+    # the only live worker is served: the round is done, not stalled
+    _ask(sched, Control.ASKPULL, server, key=2, off=0, ver=1)
+    [(_, d)] = _replies(van)
+    assert d["dest"] == DONE_DEST
+
+
+def _make_tsnode(tgt_merge, stale=False):
+    from geomx_tpu.ps.tsengine import TSNode
+
+    po = types.SimpleNamespace(
+        attach_ts=lambda node: None, is_global=False,
+        van=types.SimpleNamespace(is_stale=lambda s, e: stale))
+    return TSNode(po, kvw=None, tgt_merge=tgt_merge)
+
+
+def test_tsnode_tgt_accepts_callable_live_view():
+    """tgt re-evaluates a callable target per ask — a static int frozen
+    at construction can never be satisfied after a death (GX-P305)."""
+    live = [3]
+    node = _make_tsnode(lambda: live[0])
+    assert node.tgt == 3
+    live[0] = 2          # a contributor died; the live view shrank
+    assert node.tgt == 2
+    live[0] = 0
+    assert node.tgt == 1  # floor: a round needs at least one party
+    assert _make_tsnode(4).tgt == 4  # plain ints still work
+
+
+def test_tsnode_drops_stale_relay_without_ack():
+    """A zombie peer's DATA_TS_RELAY hop is fence-dropped: no merge into
+    the slot countdown and no ack (same fence as _handle_data)."""
+    from geomx_tpu.ps.tsengine import DATA_TS_RELAY
+
+    node = _make_tsnode(2, stale=True)
+    app = types.SimpleNamespace(responses=[])
+    app.response = lambda req, kvs=None, body="": app.responses.append(req)
+    req = types.SimpleNamespace(simple_app=False, push=True,
+                                head=DATA_TS_RELAY, sender=9, epoch=1,
+                                version=1, num_merge=1)
+    assert node.handle_request(req, None, app) is True  # consumed
+    assert app.responses == []                          # ... silently
+    assert node._slots == {}                            # ... untouched
 
 
 def test_scheduler_greedy_prefers_measured_throughput():

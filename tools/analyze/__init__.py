@@ -1,6 +1,6 @@
 """geomx-lint: project-native static analysis for geomx_tpu.
 
-Three AST passes over the tree (no imports of the analyzed code, no
+Four AST passes over the tree (no imports of the analyzed code, no
 process spawns — safe to run anywhere, including CI on a box with no
 accelerator):
 
@@ -12,6 +12,10 @@ accelerator):
   retrace patterns, missing ``donate_argnums`` on train steps.
 - **config-drift** (GX-C2xx): env_* registrations vs raw ``os.environ``
   reads vs docs/env-var-summary.md vs scripts/*.sh.
+- **protocol** (GX-P3xx): the wire-protocol model — Control verb
+  send/dispatch consistency, droppable requests, bare-key response
+  routing, unfenced countdown mutations, static-count countdowns, and
+  the binary-meta schema lock.
 
 Run ``python -m tools.analyze`` from the repo root; see
 docs/static-analysis.md for the rule catalogue, baseline workflow and
@@ -28,11 +32,13 @@ from .core import (Finding, SEV_ERROR, SEV_WARNING, SourceFile,
                    save_baseline, sort_findings, split_by_baseline)
 from .concurrency import run_concurrency
 from .config_drift import run_config_drift
+from .protocol import run_protocol, write_binmeta_lock
 from .traced import run_traced
 
 __all__ = [
     "Finding", "SEV_ERROR", "SEV_WARNING", "SourceFile",
-    "run_concurrency", "run_traced", "run_config_drift", "run_all",
+    "run_concurrency", "run_traced", "run_config_drift", "run_protocol",
+    "run_all", "write_binmeta_lock",
     "load_baseline", "save_baseline", "split_by_baseline",
     "sort_findings", "DEFAULT_BASELINE",
 ]
@@ -43,6 +49,7 @@ PASSES = {
     "concurrency": lambda sources, root: run_concurrency(sources),
     "traced": lambda sources, root: run_traced(sources),
     "config-drift": run_config_drift,
+    "protocol": run_protocol,
 }
 
 

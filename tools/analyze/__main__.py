@@ -2,7 +2,9 @@
 
 Exit codes: 0 = no findings beyond the baseline; 1 = new findings;
 2 = usage error. ``--update-baseline`` rewrites the committed baseline
-to exactly the current findings (do this after fixing or accepting)."""
+to exactly the current findings (do this after fixing or accepting);
+``--prune-baseline`` drops only the stale entries; ``--update-binmeta-
+lock`` refreshes the wire-schema lock after a BINMETA_VERSION bump."""
 
 from __future__ import annotations
 
@@ -11,15 +13,16 @@ import json
 import sys
 from pathlib import Path
 
-from . import (DEFAULT_BASELINE, PASSES, load_baseline, run_all,
-               save_baseline, split_by_baseline)
+from . import (DEFAULT_BASELINE, PASSES, load_baseline, load_sources,
+               run_all, save_baseline, split_by_baseline,
+               write_binmeta_lock)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.analyze",
-        description="geomx-lint: lock, traced-code and config-drift "
-                    "static analysis (docs/static-analysis.md)")
+        description="geomx-lint: lock, traced-code, config-drift and "
+                    "protocol static analysis (docs/static-analysis.md)")
     ap.add_argument("paths", nargs="*", default=None,
                     help="files/dirs to analyze (default: geomx_tpu/)")
     ap.add_argument("--root", default=".",
@@ -34,6 +37,12 @@ def main(argv=None) -> int:
                     help="report every finding, accepted or not")
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline to the current findings")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="drop baseline entries whose fingerprints no "
+                         "longer match any finding")
+    ap.add_argument("--update-binmeta-lock", action="store_true",
+                    help="refresh tools/analyze/binmeta.lock.json from "
+                         "the current Meta wire schema")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     args = ap.parse_args(argv)
@@ -46,12 +55,29 @@ def main(argv=None) -> int:
         print(f"unknown pass(es): {sorted(unknown)}", file=sys.stderr)
         return 2
 
+    if args.update_binmeta_lock:
+        lock = write_binmeta_lock(load_sources(paths, root), root)
+        print(f"binmeta lock updated -> {lock}")
+        return 0
+
     findings = run_all(paths, root, passes)
 
     if args.update_baseline:
         save_baseline(Path(args.baseline), findings)
         print(f"baseline updated: {len(findings)} finding(s) accepted "
               f"-> {args.baseline}")
+        return 0
+
+    if args.prune_baseline:
+        bl_path = Path(args.baseline)
+        baseline = load_baseline(bl_path)
+        live = {f.fingerprint for f in findings}
+        kept = sorted(baseline & live)
+        bl_path.write_text(
+            json.dumps({"version": 1, "findings": kept}, indent=1) + "\n",
+            encoding="utf-8")
+        print(f"baseline pruned: {len(baseline) - len(kept)} stale "
+              f"entrie(s) dropped, {len(kept)} kept -> {bl_path}")
         return 0
 
     baseline = set() if args.no_baseline else load_baseline(

@@ -7,9 +7,15 @@ accepted finding. ``symbol`` is the enclosing qualname (``Class.method``,
 ``Class.attr``, a variable name, …) and ``detail`` disambiguates multiple
 findings of one rule inside one symbol (the called name, the env var, …).
 
-Suppression: a finding is dropped when its source line, or the line
-directly above it, contains ``geomx-lint: disable=RULE[,RULE...]`` or
-``geomx-lint: disable=all``.
+Suppression: a finding is dropped when a ``geomx-lint:
+disable=RULE[,RULE...]`` (or ``disable=all``) comment sits on the
+finding's line, the line directly above it, any line of the enclosing
+*statement* (so a trailing comment on the last line of a multi-line
+call works), or the line directly above that statement — where "the
+statement" is the header only for compound statements (a ``def``'s
+signature plus its decorators, an ``if``'s test, ...), so a comment
+inside a body never suppresses findings anchored to the header and
+vice versa.
 """
 
 from __future__ import annotations
@@ -64,13 +70,46 @@ class SourceFile:
         self.lines = text.splitlines()
         self.tree: Optional[ast.Module] = None
         self.parse_error: Optional[SyntaxError] = None
+        self._spans: Optional[Dict[int, Tuple[int, int]]] = None
         try:
             self.tree = ast.parse(text, filename=str(path))
         except SyntaxError as e:  # surfaced as a finding, not a crash
             self.parse_error = e
 
+    def _statement_spans(self) -> Dict[int, Tuple[int, int]]:
+        """line -> (start, end) of the innermost enclosing statement.
+        Compound statements (def/class/if/for/...) span their HEADER
+        only — decorators through the line before the first body
+        statement — so body comments don't leak onto the header."""
+        if self._spans is not None:
+            return self._spans
+        spans: Dict[int, Tuple[int, int]] = {}
+        if self.tree is not None:
+            # ast.walk is breadth-first: children overwrite parents, so
+            # the innermost statement wins for every line
+            for node in ast.walk(self.tree):
+                if not isinstance(node, ast.stmt):
+                    continue
+                start = min([node.lineno] +
+                            [d.lineno for d in
+                             getattr(node, "decorator_list", [])])
+                body = getattr(node, "body", None)
+                if isinstance(body, list) and body:
+                    end = body[0].lineno - 1
+                else:
+                    end = node.end_lineno or node.lineno
+                for ln in range(start, end + 1):
+                    spans[ln] = (start, end)
+        self._spans = spans
+        return spans
+
     def suppressed(self, line: int, rule: str) -> bool:
-        for ln in (line, line - 1):
+        candidates = {line, line - 1}
+        span = self._statement_spans().get(line)
+        if span is not None:
+            start, end = span
+            candidates.update(range(start - 1, end + 1))
+        for ln in candidates:
             if 1 <= ln <= len(self.lines):
                 m = _DISABLE_RE.search(self.lines[ln - 1])
                 if m:

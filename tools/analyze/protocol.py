@@ -1,0 +1,531 @@
+"""Protocol pass (GX-P3xx): the wire protocol, machine-checked.
+
+Extracts a model of the ps wire protocol from the AST — the ``Control``
+verb set, every ``Meta(control_cmd=...)`` construction (send sites),
+every ``Control.X`` comparison (dispatch sites), the request-bearing
+handler tree, countdown/aggregation mutations and their epoch fences,
+and the binary-meta field schema — then checks the invariants every
+protocol rewrite so far has broken by hand:
+
+- **GX-P301** dead/unhandled Control verb: a verb that is sent but has
+  no dispatch branch (the receiver silently ignores it), dispatched but
+  never sent (dead protocol surface), or neither (dead enum member).
+- **GX-P302** droppable request: a request-bearing handler (a function
+  named ``*handle*``/``*push*``/``*pull*`` with a parameter literally
+  named ``req``) has a ``return`` path that neither forwarded ``req``
+  anywhere nor responded to it. Exempt: ``return`` under an
+  ``is_stale(...)`` fence (the one legal drop-without-ack), ``return
+  False`` (the handler-chain "not mine" decline), and ``raise`` exits.
+  Limitation: a loop that acks per-iteration but can run zero
+  iterations is NOT caught (lexical may-analysis) — audit those by
+  hand (see ``_pull_global_store``).
+- **GX-P303** bare-key response routing: a function that iterates a
+  ``.keys`` payload attribute and routes/completes per key without ever
+  consulting ``offset_of``/``.offsets`` — the PR-3 bug class where two
+  slices of one key alias the same completion slot.
+- **GX-P304** unfenced countdown mutation: a ``req``-bearing method
+  mutates aggregation state (``+=`` on an attribute, ``.append``/
+  ``.extend`` on attribute state) without an ``is_stale``/epoch fence
+  on its call path — the PR-5 zombie-push bug class. The fence
+  propagates: a method is "fenced" if it calls ``is_stale`` itself or
+  is (transitively) called by a same-class method that does.
+- **GX-P305** static-count countdown: a round/countdown target sized
+  from a static topology attribute (``num_workers`` & friends) instead
+  of the live view (``num_live_workers``/``live_worker_ids``). Flagged
+  where it matters: compared against an arrival count, or passed as a
+  ``tgt``/``expected``/``target``/``count`` keyword.
+- **GX-P306** meta schema drift: the ``_META_FIELDS`` wire schema is
+  fingerprinted into ``tools/analyze/binmeta.lock.json``; changing the
+  schema without bumping ``BINMETA_VERSION`` (or bumping without
+  refreshing the lock via ``--update-binmeta-lock``) fails the gate.
+
+Pure AST, like every geomx-lint pass: the analyzed code is never
+imported.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, SEV_ERROR, SourceFile, call_name
+
+BINMETA_LOCK_NAME = "binmeta.lock.json"
+
+# enum members that legitimately never travel as a stamped verb:
+# EMPTY is the "this is a data message" default, not a command.
+_P301_EXEMPT = {"EMPTY"}
+
+_HANDLER_NAME_RE = re.compile(r"(^|_)(handle|push|pull)")
+_COUNT_NAME_RE = re.compile(r"(received|arrived|count|nm|stops|elems)",
+                            re.IGNORECASE)
+_TGT_KWARG_RE = re.compile(r"(tgt|expected|target|count)", re.IGNORECASE)
+_STATIC_COUNT_ATTRS = {"num_workers", "num_servers", "num_global_workers",
+                       "num_all_workers"}
+
+
+def run_protocol(sources: Sequence[SourceFile],
+                 root: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    findings += _check_control_set(sources)
+    for src in sources:
+        if src.tree is None:
+            continue
+        findings += _check_droppable_requests(src)
+        findings += _check_bare_key_routing(src)
+        findings += _check_unfenced_mutations(src)
+        findings += _check_static_counts(src)
+    findings += _check_binmeta(sources, root)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _control_member(node: ast.AST) -> Optional[str]:
+    """``Control.X`` -> "X" (also matches dotted prefixes ending in
+    ``Control``, e.g. ``message.Control.X``)."""
+    if isinstance(node, ast.Attribute):
+        base = node.value
+        if isinstance(base, ast.Name) and base.id == "Control":
+            return node.attr
+        if isinstance(base, ast.Attribute) and base.attr == "Control":
+            return node.attr
+    return None
+
+
+def _iter_functions(tree: ast.Module):
+    """Yield (node, qualname, enclosing ClassDef or None) for every
+    function, with ``Class.method`` / ``fn.<locals>.inner`` qualnames."""
+    out = []
+
+    def walk(node, prefix: str, cls: Optional[ast.ClassDef]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                out.append((child, q, cls))
+                walk(child, f"{q}.<locals>.", None)
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}{child.name}.", child)
+            else:
+                walk(child, prefix, cls)
+
+    walk(tree, "", None)
+    return out
+
+
+def _contains_is_stale(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Call)
+               and call_name(n.func).split(".")[-1] == "is_stale"
+               for n in ast.walk(node))
+
+
+# ---------------------------------------------------------------------------
+# GX-P301: Control verb consistency
+# ---------------------------------------------------------------------------
+
+def _check_control_set(sources: Sequence[SourceFile]) -> List[Finding]:
+    # the enum definition (first `class Control` found wins)
+    members: Dict[str, Tuple[SourceFile, int]] = {}
+    for src in sources:
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "Control":
+                for stmt in node.body:
+                    if (isinstance(stmt, ast.Assign)
+                            and len(stmt.targets) == 1
+                            and isinstance(stmt.targets[0], ast.Name)):
+                        members.setdefault(stmt.targets[0].id,
+                                           (src, stmt.lineno))
+                break
+        if members:
+            break
+    if not members:
+        return []
+
+    sent: Dict[str, Tuple[SourceFile, int]] = {}
+    dispatched: Dict[str, Tuple[SourceFile, int]] = {}
+    for src in sources:
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg != "control_cmd":
+                        continue
+                    for sub in ast.walk(kw.value):  # incl. IfExp arms
+                        m = _control_member(sub)
+                        if m:
+                            sent.setdefault(m, (src, sub.lineno))
+            elif isinstance(node, ast.Compare):
+                for sub in [node.left] + list(node.comparators):
+                    for leaf in ast.walk(sub):  # incl. `in (A, B)` tuples
+                        m = _control_member(leaf)
+                        if m:
+                            dispatched.setdefault(m, (src, leaf.lineno))
+
+    findings = []
+    for name, (src, line) in sorted(members.items()):
+        if name in _P301_EXEMPT:
+            continue
+        if name in sent and name not in dispatched:
+            ssrc, sline = sent[name]
+            findings.append(Finding(
+                "GX-P301", SEV_ERROR, ssrc.rel, sline,
+                symbol=f"Control.{name}", detail="sent-unhandled",
+                message=f"Control.{name} is sent here but no dispatch "
+                        f"branch receives it"))
+        elif name in dispatched and name not in sent:
+            dsrc, dline = dispatched[name]
+            findings.append(Finding(
+                "GX-P301", SEV_ERROR, dsrc.rel, dline,
+                symbol=f"Control.{name}", detail="dispatched-unsent",
+                message=f"Control.{name} has a dispatch branch but is "
+                        f"never sent"))
+        elif name not in sent and name not in dispatched:
+            findings.append(Finding(
+                "GX-P301", SEV_ERROR, src.rel, line,
+                symbol=f"Control.{name}", detail="unused",
+                message=f"Control.{name} is neither sent nor dispatched"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GX-P302: droppable requests
+# ---------------------------------------------------------------------------
+
+def _check_droppable_requests(src: SourceFile) -> List[Finding]:
+    findings = []
+    for fn, qual, _cls in _iter_functions(src.tree):
+        if not _HANDLER_NAME_RE.search(fn.name):
+            continue
+        argnames = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                    + fn.args.kwonlyargs)}
+        if "req" not in argnames:
+            continue
+        # sink = any use of bare `req` that is not a plain attribute
+        # read: passed to a call, stored in a tuple/list, returned, ...
+        attr_reads = {id(n.value) for n in ast.walk(fn)
+                      if isinstance(n, ast.Attribute)}
+        sink_lines = sorted(
+            n.lineno for n in ast.walk(fn)
+            if isinstance(n, ast.Name) and n.id == "req"
+            and isinstance(n.ctx, ast.Load) and id(n) not in attr_reads)
+
+        parents: Dict[int, ast.AST] = {}
+        for node in ast.walk(fn):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+
+        def fenced(ret: ast.Return) -> bool:
+            node: ast.AST = ret
+            while id(node) in parents and node is not fn:
+                node = parents[id(node)]
+                if (isinstance(node, ast.If)
+                        and _contains_is_stale(node.test)):
+                    return True
+            return False
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Return):
+                continue
+            if any(ln <= node.lineno for ln in sink_lines):
+                continue
+            v = node.value
+            if (isinstance(v, ast.Constant) and v.value is False):
+                continue  # handler-chain decline: "not my traffic"
+            if v is not None and any(
+                    isinstance(n, ast.Name) and n.id == "req"
+                    for n in ast.walk(v)):
+                continue
+            if fenced(node):
+                continue
+            findings.append(Finding(
+                "GX-P302", SEV_ERROR, src.rel, node.lineno, symbol=qual,
+                detail=f"return@{node.lineno - fn.lineno}",
+                message=f"{fn.name} can return without forwarding or "
+                        f"responding to req (silent request drop; fence "
+                        f"with is_stale if the drop is intentional)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GX-P303: bare-key response routing
+# ---------------------------------------------------------------------------
+
+def _walk_own(fn: ast.AST):
+    """Walk a function body WITHOUT descending into nested function
+    definitions (those are analyzed as functions in their own right)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _check_bare_key_routing(src: SourceFile) -> List[Finding]:
+    findings = []
+    for fn, qual, _cls in _iter_functions(src.tree):
+        uses_range = any(
+            (isinstance(n, ast.Attribute) and n.attr in ("offsets",
+                                                         "offset_of"))
+            or (isinstance(n, ast.Name) and n.id == "offset_of")
+            for n in ast.walk(fn))
+        if uses_range:
+            continue
+        for node in _walk_own(fn):
+            if not isinstance(node, ast.For):
+                continue
+            it = node.iter
+            if (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                    and it.func.id == "enumerate" and it.args):
+                it = it.args[0]
+                key_var = (node.target.elts[1]
+                           if isinstance(node.target, ast.Tuple)
+                           and len(node.target.elts) == 2 else None)
+            else:
+                key_var = node.target
+            if not (isinstance(it, ast.Attribute) and it.attr == "keys"
+                    and isinstance(key_var, ast.Name)):
+                continue
+            # routing = indexing per-key state by the BARE key variable
+            routed = any(
+                isinstance(n, ast.Subscript)
+                and isinstance(n.slice, ast.Name)
+                and n.slice.id == key_var.id
+                for n in ast.walk(node))
+            if routed:
+                findings.append(Finding(
+                    "GX-P303", SEV_ERROR, src.rel, node.lineno,
+                    symbol=qual, detail=f"{call_name(it)}",
+                    message=f"{fn.name} routes per bare key over "
+                            f"{call_name(it)} without consulting offsets "
+                            f"— sliced keys alias one completion slot; "
+                            f"route by (key, range)"))
+                break
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GX-P304: unfenced countdown mutation
+# ---------------------------------------------------------------------------
+
+def _mutates_agg_state(fn: ast.AST) -> Optional[int]:
+    """Line of the first aggregation-state mutation in ``fn``:
+    ``x.attr += ...`` or ``x.attr.append/extend(...)`` where the
+    receiver is attribute state (not a bare local)."""
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Add)
+                and isinstance(node.target, ast.Attribute)):
+            return node.lineno
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("append", "extend")
+                and isinstance(node.func.value, ast.Attribute)):
+            return node.lineno
+    return None
+
+
+def _check_unfenced_mutations(src: SourceFile) -> List[Finding]:
+    findings = []
+    by_class: Dict[Optional[str], List[Tuple[ast.AST, str]]] = {}
+    cls_of: Dict[str, Optional[str]] = {}
+    for fn, qual, cls in _iter_functions(src.tree):
+        cname = cls.name if cls is not None else None
+        by_class.setdefault(cname, []).append((fn, qual))
+        cls_of[qual] = cname
+
+    for cname, fns in by_class.items():
+        if cname is None:
+            continue
+        methods = {fn.name: fn for fn, _q in fns}
+        # fence roots: methods that themselves call is_stale
+        fenced: Set[str] = {name for name, fn in methods.items()
+                            if _contains_is_stale(fn)}
+        # propagate: callees of a fenced method run behind its fence
+        frontier = list(fenced)
+        while frontier:
+            m = frontier.pop()
+            for node in ast.walk(methods[m]):
+                if isinstance(node, ast.Call):
+                    cn = call_name(node.func)
+                    if cn.startswith("self."):
+                        callee = cn.split(".", 1)[1]
+                        if callee in methods and callee not in fenced:
+                            fenced.add(callee)
+                            frontier.append(callee)
+        for fn, qual in fns:
+            if fn.name in fenced:
+                continue
+            argnames = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                        + fn.args.kwonlyargs)}
+            if "req" not in argnames:
+                continue
+            line = _mutates_agg_state(fn)
+            if line is None:
+                continue
+            findings.append(Finding(
+                "GX-P304", SEV_ERROR, src.rel, fn.lineno, symbol=qual,
+                detail="unfenced-mutation",
+                message=f"{fn.name} mutates aggregation state (line "
+                        f"{line}) from a request without an "
+                        f"is_stale/epoch fence on its call path (zombie "
+                        f"senders can corrupt countdowns)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GX-P305: static-count countdowns
+# ---------------------------------------------------------------------------
+
+def _involves_len_or_count(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                and n.func.id == "len"):
+            return True
+        name = (getattr(n, "attr", None) if isinstance(n, ast.Attribute)
+                else getattr(n, "id", None) if isinstance(n, ast.Name)
+                else None)
+        if name and _COUNT_NAME_RE.search(name):
+            return True
+    return False
+
+
+def _check_static_counts(src: SourceFile) -> List[Finding]:
+    findings = []
+    for fn, qual, _cls in _iter_functions(src.tree):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Compare):
+                sides = [node.left] + list(node.comparators)
+                for i, side in enumerate(sides):
+                    for leaf in ast.walk(side):
+                        if (isinstance(leaf, ast.Attribute)
+                                and leaf.attr in _STATIC_COUNT_ATTRS):
+                            others = sides[:i] + sides[i + 1:]
+                            if any(_involves_len_or_count(o)
+                                   for o in others):
+                                findings.append(Finding(
+                                    "GX-P305", SEV_ERROR, src.rel,
+                                    leaf.lineno, symbol=qual,
+                                    detail=f"compare:{leaf.attr}",
+                                    message=f"countdown compared against "
+                                            f"static {leaf.attr}; size "
+                                            f"rounds from the live view "
+                                            f"(num_live_workers / "
+                                            f"live_worker_ids)"))
+            elif isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg is None or not _TGT_KWARG_RE.search(kw.arg):
+                        continue
+                    for leaf in ast.walk(kw.value):
+                        if (isinstance(leaf, ast.Attribute)
+                                and leaf.attr in _STATIC_COUNT_ATTRS):
+                            findings.append(Finding(
+                                "GX-P305", SEV_ERROR, src.rel,
+                                leaf.lineno, symbol=qual,
+                                detail=f"kwarg:{kw.arg}:{leaf.attr}",
+                                message=f"{kw.arg}= sized from static "
+                                        f"{leaf.attr}; pass the live "
+                                        f"view (num_live_workers / a "
+                                        f"callable) instead"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GX-P306: binary-meta schema fingerprint
+# ---------------------------------------------------------------------------
+
+def extract_meta_schema(sources: Sequence[SourceFile]):
+    """-> (src, line, version, [(name, kind), ...]) or None."""
+    for src in sources:
+        if src.tree is None:
+            continue
+        fields = version = None
+        line = 0
+        for node in ast.walk(src.tree):
+            # both `X = [...]` and the annotated `X: List[...] = [...]`
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                tgt = node.target
+            else:
+                continue
+            name = tgt.id if isinstance(tgt, ast.Name) else None
+            if name == "_META_FIELDS" and isinstance(node.value,
+                                                     (ast.List, ast.Tuple)):
+                out = []
+                for elt in node.value.elts:
+                    if (isinstance(elt, ast.Tuple) and len(elt.elts) == 2
+                            and all(isinstance(e, ast.Constant)
+                                    for e in elt.elts)):
+                        out.append((elt.elts[0].value, elt.elts[1].value))
+                fields, line = out, node.lineno
+            elif name == "BINMETA_VERSION" and isinstance(
+                    node.value, ast.Constant):
+                version = node.value.value
+        if fields is not None:
+            return src, line, version, fields
+    return None
+
+
+def meta_schema_fingerprint(fields) -> str:
+    blob = ";".join(f"{n}:{k}" for n, k in fields)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def binmeta_lock_path(root: Path) -> Path:
+    return Path(root) / "tools" / "analyze" / BINMETA_LOCK_NAME
+
+
+def write_binmeta_lock(sources: Sequence[SourceFile], root: Path) -> Path:
+    schema = extract_meta_schema(sources)
+    if schema is None:
+        raise ValueError("no _META_FIELDS definition in the analyzed tree")
+    _src, _line, version, fields = schema
+    path = binmeta_lock_path(root)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(
+        {"version": version,
+         "fingerprint": meta_schema_fingerprint(fields)},
+        indent=1) + "\n", encoding="utf-8")
+    return path
+
+
+def _check_binmeta(sources: Sequence[SourceFile],
+                   root: Path) -> List[Finding]:
+    schema = extract_meta_schema(sources)
+    if schema is None:
+        return []  # tree has no binary meta codec: nothing to lock
+    src, line, version, fields = schema
+    fp = meta_schema_fingerprint(fields)
+    lock_file = binmeta_lock_path(root)
+    if not lock_file.exists():
+        return [Finding(
+            "GX-P306", SEV_ERROR, src.rel, line, symbol="_META_FIELDS",
+            detail="lock-missing",
+            message="no binmeta schema lock; run `python -m tools.analyze "
+                    "--update-binmeta-lock` and commit it")]
+    lock = json.loads(lock_file.read_text(encoding="utf-8"))
+    if version != lock.get("version"):
+        return [Finding(
+            "GX-P306", SEV_ERROR, src.rel, line, symbol="_META_FIELDS",
+            detail="version-changed",
+            message=f"BINMETA_VERSION is {version} but the lock holds "
+                    f"{lock.get('version')}; refresh the lock with "
+                    f"--update-binmeta-lock")]
+    if fp != lock.get("fingerprint"):
+        return [Finding(
+            "GX-P306", SEV_ERROR, src.rel, line, symbol="_META_FIELDS",
+            detail="schema-changed",
+            message="Meta wire schema changed without a BINMETA_VERSION "
+                    "bump — a mixed-version cluster would mis-decode "
+                    "frames; bump BINMETA_VERSION, then refresh the lock")]
+    return []
