@@ -194,6 +194,18 @@ class Config:
     # sends-to-dead on its own traffic, and reports at stop(); the
     # dynamic dual of the GX-P3xx protocol pass. Test/chaos-matrix aid
     wire_sanitizer: bool = False        # GEOMX_WIRE_SANITIZER
+    # ---- telemetry / flight recorder (ours; docs/observability.md) ----
+    # metrics registry (geomx_tpu/telemetry.py): labeled counters/gauges/
+    # histograms fed by the van, resender, servers and round futures;
+    # near-free when off. Snapshots export per round when telemetry_dir
+    # is set, and are pullable over the command channel via kv.metrics()
+    telemetry: bool = False             # GEOMX_TELEMETRY
+    telemetry_dir: str = ""             # GEOMX_TELEMETRY_DIR ("" = no export)
+    # crash flight recorder (ps/flightrec.py): always-on bounded ring of
+    # recent wire/membership events per van, auto-dumped on crash,
+    # round abort/timeout and sanitizer violations. 0 disables the ring
+    flightrec_size: int = 256           # GEOMX_FLIGHTREC_SIZE
+    flightrec_dir: str = ""             # GEOMX_FLIGHTREC_DIR ($TMPDIR/geomx_flightrec)
     verbose: int = 0                    # PS_VERBOSE
     # round-4 verdict item 2: the reference makes its transport deadlines
     # env-tunable (van.cc:527-533 PS_RESEND_TIMEOUT / heartbeat envs);
@@ -301,6 +313,10 @@ def load() -> Config:
         epoch_grace_s=env_float("PS_EPOCH_GRACE", 0.0),
         chunk_retries=env_int("PS_CHUNK_RETRIES", 0),
         wire_sanitizer=env_bool("GEOMX_WIRE_SANITIZER"),
+        telemetry=env_bool("GEOMX_TELEMETRY"),
+        telemetry_dir=env_str("GEOMX_TELEMETRY_DIR"),
+        flightrec_size=env_int("GEOMX_FLIGHTREC_SIZE", 256),
+        flightrec_dir=env_str("GEOMX_FLIGHTREC_DIR"),
         verbose=env_int("PS_VERBOSE", 0),
         barrier_timeout_s=env_float("PS_BARRIER_TIMEOUT", 600.0),
         op_timeout_s=env_float("PS_OP_TIMEOUT", 300.0),

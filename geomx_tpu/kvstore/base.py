@@ -38,6 +38,7 @@ class Command:
     REPLICA_UPDATE = 12           # server -> peer server: snapshot delta
     #                               (durable recovery; docs/robustness.md)
     REPLICA_FETCH = 13            # recovering server <- peer: full replica
+    METRICS = 14                  # worker <- server: telemetry snapshot JSON
 
 
 # Data-plane cmd values carried in push meta.head.

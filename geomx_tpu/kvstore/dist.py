@@ -37,6 +37,7 @@ import numpy as np
 
 from geomx_tpu import config as cfg_mod
 from geomx_tpu import profiler
+from geomx_tpu import telemetry
 from geomx_tpu.kvstore import sharding
 from geomx_tpu.kvstore.base import Command, DATA_INIT, KVStore, _sum_values
 from geomx_tpu.kvstore.frontier import RoundFuture, give_up_exc, plan_chunks
@@ -112,6 +113,10 @@ class KVStoreDist(KVStore):
         self._outstanding_key: Dict[int, int] = {}
         # transport give-ups recorded by callbacks; surfaced by wait()
         self._transport_errors: List[str] = []
+        # round clock for trace stamping: every combined round gets an
+        # id carried in Meta.trace_round on each of its wire messages;
+        # notify_round() re-syncs it to the trainer's numbering
+        self._round_seq = 0
 
         # startup barrier (reference: kvstore_dist.h:64), then the
         # creation-time command protocol (reference: kvstore.cc:56-63).
@@ -164,7 +169,7 @@ class KVStoreDist(KVStore):
         n = self.po.num_dead_nodes(role=role)
         tag = ("dead_nodes" if role is None
                else f"dead_{Role(role).name.lower()}s")
-        profiler.counter(f"membership.{tag}", n, cat="membership")
+        telemetry.sample(f"membership.{tag}", n, cat="membership")
         return n
 
     def membership_epoch(self) -> int:
@@ -172,8 +177,29 @@ class KVStoreDist(KVStore):
 
     def notify_round(self, round_idx: int) -> None:
         """Advance the training-round clock (deterministic FaultPlan
-        kill-at-round rules consult it)."""
+        kill-at-round rules consult it); also exports this node's
+        telemetry snapshot for the closing round (GEOMX_TELEMETRY_DIR)
+        and re-syncs the trace-round clock to the trainer's numbering."""
         self.po.van.notify_round(round_idx)
+        with self._lock:
+            self._round_seq = max(self._round_seq, round_idx)
+        telemetry.export_round(round_idx)
+
+    def _begin_round(self) -> int:
+        """Next trace-round id: stamped into Meta.trace_round on every
+        message of one combined round so the merged cross-node trace can
+        follow it worker -> local server -> global server -> worker."""
+        with self._lock:
+            self._round_seq += 1
+            return self._round_seq
+
+    def _abort_round(self, reason: str) -> None:
+        """RoundFuture on_abort hook: a round died at the caller
+        (timeout / give-up) — preserve this node's recent wire history."""
+        telemetry.event("round.abort", cat="kvstore", reason=reason[:200])
+        rec = self.po.van.flightrec
+        rec.record("note", event="round_abort", reason=reason[:200])
+        rec.dump("round_abort")
 
     # -- helpers ---------------------------------------------------------
 
@@ -244,7 +270,8 @@ class KVStoreDist(KVStore):
             # initialized (a duplicate DATA_INIT is acked and ignored)
             self.barrier()
 
-    def push(self, key, value, priority: int = 0) -> None:
+    def push(self, key, value, priority: int = 0,
+             trace_round: int = -1) -> None:
         keys = self._as_key_list(key)
         values = value if isinstance(value, (list, tuple)) and len(keys) > 1 \
             else [value]
@@ -260,7 +287,8 @@ class KVStoreDist(KVStore):
                 # (the server merges per-key acks —
                 # kvstore.server._BatchResponder). Cuts the per-round
                 # message count from 2*n_keys to 2*n_servers.
-                self._push_batch(keys, values, priority)
+                self._push_batch(keys, values, priority,
+                                 trace_round=trace_round)
                 return
             if self.cfg.enable_p3:
                 # P3 wants per-key messages so the priority send thread
@@ -268,7 +296,8 @@ class KVStoreDist(KVStore):
                 # later entries get lower priority (reference:
                 # kvstore_dist.h:768 slicing + van.cc:548 queues)
                 for i, (k, v) in enumerate(zip(keys, values)):
-                    self.push(k, v, priority=priority - i)
+                    self.push(k, v, priority=priority - i,
+                              trace_round=trace_round)
                 return
         for k, v in zip(keys, values):
             merged = _sum_values(v)
@@ -291,9 +320,11 @@ class KVStoreDist(KVStore):
                               offsets=[sh.offset], totals=[sh.total],
                               lens=[sh.length])
                 self.kvw.push(kvs, sh.server_rank, priority=priority,
+                              trace_round=trace_round,
                               cb=lambda ts, kk=k: self._on_push_ack(kk, ts))
 
-    def _push_batch(self, keys: List[int], values, priority: int) -> None:
+    def _push_batch(self, keys: List[int], values, priority: int,
+                    trace_round: int = -1) -> None:
         per_server: Dict[int, KVPairs] = {}
         server_keys: Dict[int, List[int]] = {}
         for k, v in zip(keys, values):
@@ -308,11 +339,12 @@ class KVStoreDist(KVStore):
                 kvs.totals.append(sh.total)
                 kvs.lens.append(sh.length)
                 server_keys.setdefault(sh.server_rank, []).append(k)
-        self._send_batch_pushes(per_server, server_keys, priority)
+        self._send_batch_pushes(per_server, server_keys, priority,
+                                trace_round=trace_round)
 
     def _send_batch_pushes(self, per_server: Dict[int, KVPairs],
                            server_keys: Dict[int, List[int]],
-                           priority: int) -> None:
+                           priority: int, trace_round: int = -1) -> None:
         """Shared tail of the batched push paths: register per-(server,
         shard) ack bookkeeping and send one message per server."""
         with self._lock:
@@ -326,6 +358,7 @@ class KVStoreDist(KVStore):
         for srank, kvs in per_server.items():
             ks = tuple(server_keys[srank])
             self.kvw.push(kvs, srank, priority=priority,
+                          trace_round=trace_round,
                           cb=lambda ts, kk=ks:
                           self._on_batch_push_ack(kk, ts))
 
@@ -404,8 +437,10 @@ class KVStoreDist(KVStore):
             else [out]
         if (len(keys) == 1 or self._ts is not None
                 or self.cfg.enable_p3):
-            self.push(key, value, priority=priority)
-            self.pull(key, out=out, priority=priority)
+            # still one logical round: both legs carry the same trace id
+            rid = self._begin_round()
+            self.push(key, value, priority=priority, trace_round=rid)
+            self.pull(key, out=out, priority=priority, trace_round=rid)
             return
         if len(set(keys)) != len(keys):
             raise ValueError("push_pull: duplicate keys in one round")
@@ -413,6 +448,7 @@ class KVStoreDist(KVStore):
             if not (isinstance(o, np.ndarray) and o.flags.writeable):
                 raise TypeError(
                     "push_pull requires writable numpy ndarrays")
+        rid = self._begin_round()
         per_server: Dict[int, KVPairs] = {}
         server_keys: Dict[int, List[int]] = {}
         for k, v in zip(keys, values):
@@ -490,7 +526,8 @@ class KVStoreDist(KVStore):
                     fallback.append(k)
             if fallback:
                 self._pull_batch(fallback,
-                                 [out_of[k] for k in fallback], priority)
+                                 [out_of[k] for k in fallback], priority,
+                                 trace_round=rid)
             # the ack also advances the push-ordering bookkeeping so a
             # subsequent plain pull stays ordered after this round
             ready = []
@@ -507,6 +544,7 @@ class KVStoreDist(KVStore):
 
         for srank, kvs in per_server.items():
             self.kvw.push(kvs, srank, priority=priority, pull=True,
+                          trace_round=rid,
                           cb=lambda ts, s=srank: on_resp(ts, s))
 
     def _consume_errors(self, errs: List[str]) -> None:
@@ -562,8 +600,10 @@ class KVStoreDist(KVStore):
         chunks = plan_chunks(list(range(len(entries))),
                              [e[2].nbytes for e in entries],
                              sb, base_priority=priority)
+        rid = self._begin_round()
         fut = RoundFuture(keys, consume=self._consume_errors,
-                          max_retries=self.cfg.chunk_retries)
+                          max_retries=self.cfg.chunk_retries,
+                          on_abort=self._abort_round)
         bufs = {k: np.zeros(self._key_info[k].total, np.float32)
                 for k in keys}
         out_of = dict(zip(keys, outs))
@@ -614,9 +654,11 @@ class KVStoreDist(KVStore):
                 log.warning("push_pull_async chunk %d to server %d "
                             "failed (%s); retry %d/%d", cid, srank,
                             fail, fut.retries_used(cid), fut.max_retries)
-                profiler.instant("chunk.retry", cat="kvstore",
-                                 chunk=cid, server=srank)
+                telemetry.event("chunk.retry", cat="kvstore",
+                                chunk=cid, server=srank)
+                telemetry.counter_inc("chunk.retries")
                 self.kvw.push(m_kvs, srank, priority=m_prio, pull=True,
+                              trace_round=rid, trace_chunk=cid,
                               cb=lambda ts2, m=mid: on_resp(ts2, m))
                 return
             failed_keys = []
@@ -668,7 +710,7 @@ class KVStoreDist(KVStore):
             if fallback:
                 self._pull_batch(fallback,
                                  [out_of[k] for k in fallback], priority,
-                                 on_key=fut.complete_key)
+                                 on_key=fut.complete_key, trace_round=rid)
             ready = []
             with self._lock:
                 for k in mks:
@@ -687,10 +729,12 @@ class KVStoreDist(KVStore):
             with profiler.chunk_scope("send", cid, server=srank,
                                       keys=len(kvs.keys)):
                 self.kvw.push(kvs, srank, priority=prio, pull=True,
+                              trace_round=rid, trace_chunk=cid,
                               cb=lambda ts, m=mid: on_resp(ts, m))
         return fut
 
-    def pull(self, key, out=None, priority: int = 0):
+    def pull(self, key, out=None, priority: int = 0,
+             trace_round: int = -1):
         """Async pull into ``out`` (ordered after this key's push acks);
         blocking when ``out`` is None. Use wait()/waitall to join.
 
@@ -705,23 +749,25 @@ class KVStoreDist(KVStore):
         if len(keys) > 1 and self.cfg.enable_p3 and out is not None:
             # per-key prioritized pulls (see the push list form)
             for i, (k, o) in enumerate(zip(keys, outs)):
-                self._pull_one(k, o, priority - i)
+                self._pull_one(k, o, priority - i, trace_round=trace_round)
             return None
         if (len(keys) > 1 and out is not None
                 and not (self._ts is not None
                          and any(self._ts_ver.get(k, 0) for k in keys))):
-            self._pull_batch(keys, list(outs), priority)
+            self._pull_batch(keys, list(outs), priority,
+                             trace_round=trace_round)
             return None
         results = []
         for k, o in zip(keys, outs):
-            results.append(self._pull_one(k, o, priority))
+            results.append(self._pull_one(k, o, priority,
+                                          trace_round=trace_round))
         if out is None:
             return results[0] if len(results) == 1 else results
         return None
 
     def _pull_batch(self, keys: List[int], outs: List, priority: int,
-                    on_key: Optional[Callable[[int], None]] = None
-                    ) -> None:
+                    on_key: Optional[Callable[[int], None]] = None,
+                    trace_round: int = -1) -> None:
         for k, o in zip(keys, outs):
             assert self._key_info.get(k) is not None, \
                 f"pull of key {k} before init"
@@ -789,14 +835,15 @@ class KVStoreDist(KVStore):
             def issue(sr=srank, kv=kvs):
                 self.kvw.pull(kv.keys, sr, offsets=kv.offsets,
                               totals=kv.totals, lens=kv.lens,
-                              priority=priority,
+                              priority=priority, trace_round=trace_round,
                               cb=lambda ts, s=sr: on_data(ts, s))
 
             # the message must not go out until EVERY key in it has its
             # push round acked (the per-key freshness ordering, batched)
             self._issue_after_push_acks(set(server_keys[srank]), issue)
 
-    def _pull_one(self, key: int, out, priority: int):
+    def _pull_one(self, key: int, out, priority: int,
+                  trace_round: int = -1):
         info = self._key_info.get(key)
         assert info is not None, f"pull of key {key} before init"
         if self._ts is not None and self._ts_ver.get(key, 0) > 0:
@@ -829,6 +876,7 @@ class KVStoreDist(KVStore):
                 self.kvw.pull(
                     [key], sh.server_rank, offsets=[sh.offset],
                     totals=[sh.total], lens=[sh.length], priority=priority,
+                    trace_round=trace_round,
                     cb=lambda ts, s=sh: on_data(ts, s))
 
         def on_data(ts: int, sh: sharding.Shard):
@@ -1176,6 +1224,7 @@ class KVStoreDist(KVStore):
                                        timeout=timeout)
         per_server, server_keys = self._prepare_bsc_shards(
             keys, values_list, indices_list)
+        rid = self._begin_round()
         parts: Dict[int, List] = {k: [] for k in keys}
         fails: List[str] = []
         done = threading.Event()
@@ -1230,6 +1279,7 @@ class KVStoreDist(KVStore):
 
         for srank, kvs in per_server.items():
             self.kvw.push(kvs, srank, priority=priority, pull=True,
+                          trace_round=rid,
                           cb=lambda ts, s=srank: on_resp(ts, s))
 
         expected_parts = {k: sum(1 for ks in server_keys.values()
@@ -1290,8 +1340,10 @@ class KVStoreDist(KVStore):
         sizes = [np.asarray(v).size * 8 for v in values_list]
         chunks = plan_chunks(list(range(len(keys))), sizes, sb,
                              base_priority=priority)
+        rid = self._begin_round()
         fut = RoundFuture(keys, consume=self._consume_errors,
-                          max_retries=self.cfg.chunk_retries)
+                          max_retries=self.cfg.chunk_retries,
+                          on_abort=self._abort_round)
         parts: Dict[int, List] = {k: [] for k in keys}
         expected_parts: Dict[int, int] = {}
         msgs = []  # (mid, cid, srank, kvs, msg_keys, chunk_priority)
@@ -1330,9 +1382,11 @@ class KVStoreDist(KVStore):
                 log.warning("push_pull_bsc_async chunk %d to server %d "
                             "failed (%s); retry %d/%d", cid, srank,
                             fail, fut.retries_used(cid), fut.max_retries)
-                profiler.instant("chunk.retry", cat="kvstore",
-                                 chunk=cid, server=srank)
+                telemetry.event("chunk.retry", cat="kvstore",
+                                chunk=cid, server=srank)
+                telemetry.counter_inc("chunk.retries")
                 self.kvw.push(m_kvs, srank, priority=m_prio, pull=True,
+                              trace_round=rid, trace_chunk=cid,
                               cb=lambda ts2, m=mid: on_resp(ts2, m))
                 return
             failed_keys = []
@@ -1404,6 +1458,7 @@ class KVStoreDist(KVStore):
             with profiler.chunk_scope("send", cid, server=srank,
                                       keys=len(kvs.keys)):
                 self.kvw.push(kvs, srank, priority=prio, pull=True,
+                              trace_round=rid, trace_chunk=cid,
                               cb=lambda ts, m=mid: on_resp(ts, m))
         return fut
 
@@ -1684,6 +1739,20 @@ class KVStoreDist(KVStore):
             per_server.update(json.loads(body))
         checkpoint._atomic_write(
             fname, json.dumps(per_server).encode())
+
+    def metrics(self, timeout: float = 30.0) -> Dict[str, object]:
+        """Pull telemetry snapshots over the command channel: this
+        worker's own registry plus one per local server that answers
+        (Command.METRICS). Returns ``{"worker": snap,
+        "servers": [snap, ...]}`` — snapshots are the plain-dict form of
+        :func:`geomx_tpu.telemetry.snapshot`."""
+        import json
+
+        ts = self.kvw.request(Command.METRICS, "", psbase.SERVER_GROUP)
+        self.kvw.wait(ts, timeout)
+        servers = [json.loads(b)
+                   for b in self.kvw.take_response_bodies(ts) if b]
+        return {"worker": telemetry.snapshot(), "servers": servers}
 
     def load_optimizer_states(self, fname: str) -> None:
         with open(fname, "rb") as f:

@@ -30,7 +30,10 @@ This module holds the two store-agnostic pieces:
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+from geomx_tpu import telemetry
 
 __all__ = ["give_up_exc", "Chunk", "plan_chunks", "RoundFuture",
            "RoundAborted", "WorkerLostError"]
@@ -126,8 +129,15 @@ class RoundFuture:
 
     def __init__(self, keys: Iterable[int],
                  consume: Optional[Callable[[List[str]], None]] = None,
-                 max_retries: int = 0):
+                 max_retries: int = 0,
+                 on_abort: Optional[Callable[[str], None]] = None):
         self._cv = threading.Condition()
+        # fired (best-effort, outside the lock) just before wait() raises
+        # a timeout or give-up — the issuing store hooks the flight
+        # recorder here so a dead round leaves its wire history behind
+        self._on_abort = on_abort
+        self._born = time.monotonic()
+        self._latency_observed = False
         self._keys: List[int] = list(keys)
         self._pending = set(self._keys)
         assert len(self._pending) == len(self._keys), \
@@ -182,6 +192,16 @@ class RoundFuture:
         for fn in cbs:
             fn(key)
 
+    def _abort(self, reason: str) -> None:
+        """Best-effort abort hook; never lets a hook failure mask the
+        round's own error."""
+        if self._on_abort is None:
+            return
+        try:
+            self._on_abort(reason)
+        except Exception:  # noqa: BLE001
+            pass
+
     # -- joining (caller side) --------------------------------------------
 
     def done(self, keys: Optional[Iterable[int]] = None) -> bool:
@@ -211,16 +231,26 @@ class RoundFuture:
         mapping, consuming them from the store's global list."""
         klist = self._keys if keys is None else list(keys)
         with self._cv:
-            if not self._cv.wait_for(
-                    lambda: all(k not in self._pending for k in klist),
-                    timeout):
-                left = [k for k in klist if k in self._pending]
-                raise TimeoutError(
-                    f"RoundFuture.wait: keys still pending {left}")
+            done = self._cv.wait_for(
+                lambda: all(k not in self._pending for k in klist),
+                timeout)
+            left = [k for k in klist if k in self._pending]
             errs = [e for k in klist for e in self._errors.get(k, [])]
+            round_done = done and not self._pending and not self._errors \
+                and not self._latency_observed
+            if round_done:
+                self._latency_observed = True
+        if not done:
+            self._abort(f"timeout: keys still pending {left}")
+            raise TimeoutError(
+                f"RoundFuture.wait: keys still pending {left}")
+        if round_done:
+            telemetry.histogram_obs(
+                "round.latency_ms", (time.monotonic() - self._born) * 1e3)
         if errs:
             if self._consume is not None:
                 self._consume(errs)
+            self._abort("give_up: " + "; ".join(errs))
             raise give_up_exc(errs)("transport gave up on "
                                     + "; ".join(errs))
 

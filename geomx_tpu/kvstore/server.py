@@ -62,6 +62,7 @@ from geomx_tpu import checkpoint  # module-level: used in handler threads
 from geomx_tpu import config as cfg_mod
 from geomx_tpu import kernels_native
 from geomx_tpu import profiler
+from geomx_tpu import telemetry
 from geomx_tpu.compression import make_compressor
 from geomx_tpu.kvstore import sharding
 from geomx_tpu.kvstore.base import Command, DATA_INIT
@@ -299,6 +300,12 @@ class KVStoreDistServer:
         self._g_rounds: Dict[Tuple[int, int], int] = {}
         # per-transport-thread forward collector (batched WAN hop)
         self._fwd_tls = threading.local()
+        # trace context of the most recent traced worker push (round id,
+        # origin rank): stamped onto the WAN forwards so the merged
+        # trace follows one round across both tiers. Last-writer-wins is
+        # fine — all messages of one round carry the same round id, and
+        # an overlapping round mislabels at most its neighbor's frames.
+        self._wan_trace: Tuple[int, int] = (-1, -1)
         # ESync state server (Command.ESYNC_STATE; geomx_tpu.esync) —
         # constructed eagerly: lazy init would be a check-then-set race
         # across per-connection reader threads
@@ -479,8 +486,9 @@ class KVStoreDistServer:
             log.warning("membership epoch %d (dead=%s): released %d "
                         "stalled aggregation round(s)", epoch,
                         sorted(dead), released)
-            profiler.instant("membership.rounds_released",
-                             cat="membership", epoch=epoch, n=released)
+            telemetry.event("membership.rounds_released",
+                            cat="membership", epoch=epoch, n=released)
+            telemetry.counter_inc("membership.rounds_released", released)
         for fn in acts:
             fn()
         # the cross-party worker barrier may be satisfied now too
@@ -532,10 +540,13 @@ class KVStoreDistServer:
                 log.warning("dropping stale push from node %d "
                             "(epoch %d, membership epoch %d)",
                             req.sender, req.epoch, van.membership_epoch)
-                profiler.instant("membership.stale_push_dropped",
-                                 cat="membership", sender=req.sender,
-                                 epoch=req.epoch)
+                telemetry.event("membership.stale_push_dropped",
+                                cat="membership", sender=req.sender,
+                                epoch=req.epoch)
+                telemetry.counter_inc("membership.stale_pushes_dropped")
                 return
+            if not global_tier and req.trace_round >= 0:
+                self._wan_trace = (req.trace_round, req.trace_origin)
         acts: List[Action] = []
         if len(kvs.keys) > 1:
             # multi-key request: N independent per-key machines each ack
@@ -584,6 +595,15 @@ class KVStoreDistServer:
         else:
             for fn in acts:
                 fn()
+        if telemetry.enabled():
+            # aggregation queue depth: key states still holding queued
+            # pushes (lock-free reads — a gauge tolerates a torn glance)
+            with self._lock:
+                states = list(self._states.values())
+            depth = sum(1 for st in states
+                        if st.push_reqs or st.staging)
+            telemetry.gauge_set("server.agg_pending", depth,
+                                tier="global" if global_tier else "local")
 
     def _handle_one_key(self, req, kvs, srv, global_store, global_tier,
                         acts, i, key, off, total, tagging) -> None:
@@ -1152,6 +1172,13 @@ class KVStoreDistServer:
     #  :936-950, pull-back assembly :952-1167)
     # ------------------------------------------------------------------
 
+    def _wan_trace_kwargs(self) -> Dict[str, int]:
+        """Trace context for WAN re-issues of the current round — the
+        forwarded frames inherit the worker push's round id and origin
+        rank so trace_merge can stitch the tiers."""
+        r, o = self._wan_trace
+        return {"trace_round": r, "trace_origin": o}
+
     def _forward_to_global(self, key: int, off: int, cycle: int) -> None:
         if self.ts_global is not None and self.sync_global_mode:
             self._ts_forward_to_global(key, off, cycle)
@@ -1190,6 +1217,7 @@ class KVStoreDistServer:
                       compr=compr)
         self.worker_global.push(
             kvs, g_rank, party_nsrv=self.po_local.num_servers,
+            **self._wan_trace_kwargs(),
             cb=lambda ts, k=key, o=off, c=cycle, g=g_rank, l=lo, h=hi,
             t=total: self._on_global_push_ack(k, o, c, g, l, h, t, ts))
 
@@ -1241,7 +1269,7 @@ class KVStoreDistServer:
                 compr=compr)
             self.worker_global.push(
                 kvs, g_rank, party_nsrv=self.po_local.num_servers,
-                pull=True,
+                pull=True, **self._wan_trace_kwargs(),
                 cb=lambda ts, its=items, g=g_rank:
                     self._on_global_push_ack_batch(its, g, ts))
 
@@ -1337,7 +1365,7 @@ class KVStoreDistServer:
                 offsets=[it[3] for it in items],
                 totals=[it[5] for it in items],
                 lens=[it[4] - it[3] for it in items],
-                compr=tag,
+                compr=tag, **self._wan_trace_kwargs(),
                 cb=lambda ts, its=items, g=g_rank:
                     self._on_global_pull_data_batch(its, g, ts))
 
@@ -1461,6 +1489,7 @@ class KVStoreDistServer:
             self.worker_global.push(
                 kvs, rng.server_rank, num_merge=num_merge,
                 party_nsrv=self.po_local.num_servers,
+                **self._wan_trace_kwargs(),
                 cb=lambda _ts: None)
 
     def _num_parties(self) -> int:
@@ -1560,6 +1589,7 @@ class KVStoreDistServer:
         self.worker_global.pull(
             [key], g_rank, offsets=[lo], totals=[total], lens=[hi - lo],
             compr=self.gc.pull_compr_tag(hi - lo),
+            **self._wan_trace_kwargs(),
             cb=lambda ts, k=key, o=off, l=lo, h=hi, c=cycle, g=g_rank,
             t=total: self._on_global_pull_data(k, o, l, h, ts, c, g, t))
 
@@ -1688,6 +1718,12 @@ class KVStoreDistServer:
                     if self.is_global_server and self.po_global is not None
                     else self.po_local.my_rank)
             srv.response(req, body=json.dumps({str(rank): states_hex}))
+            return
+        if head == Command.METRICS:
+            # this node's telemetry snapshot (worker pull via
+            # kv.metrics()); the registry is process-wide, so a server
+            # process answers once with both tiers' counters in it
+            srv.response(req, body=telemetry.snapshot_json())
             return
         if head == Command.REPLICA_UPDATE:
             # a peer server's snapshot delta (kvstore/replication.py);

@@ -180,11 +180,18 @@ def dumps() -> str:
 
 
 def dump(finished: bool = True, filename: Optional[str] = None) -> str:
-    """Write the trace file (reference: DumpProfile :304); returns path."""
+    """Write the trace file (reference: DumpProfile :304); returns path.
+
+    The write is atomic (tmp + rename): tools/trace_merge.py and the
+    chaos-matrix artifact collector read these files from other
+    processes, and a dump interrupted by a crash must never leave a
+    truncated JSON where a previous good trace stood."""
     path = filename or _config.get("filename", "profile.json")
     data = dumps()
-    with open(path, "w") as f:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
         f.write(data)
+    os.replace(tmp, path)
     if finished:
         with _lock:
             _events.clear()

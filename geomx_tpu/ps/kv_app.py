@@ -87,6 +87,11 @@ class ReqMeta:
     # membership epoch the sender stamped; servers fence stale pushes
     # (van.is_stale) so a declared-dead zombie can't pollute aggregation
     epoch: int = 0
+    # trace context carried by the request (ps/message.py Meta); servers
+    # copy it onto forwarded global-tier messages and responses echo it
+    trace_round: int = -1
+    trace_chunk: int = -1
+    trace_origin: int = -1
 
 
 def _pack_kv(meta: Meta, kvs: KVPairs) -> Message:
@@ -217,6 +222,9 @@ class KVWorker:
         num_merge: int = 1,
         party_nsrv: int = 1,
         pull: bool = False,
+        trace_round: int = -1,
+        trace_chunk: int = -1,
+        trace_origin: int = -1,
         cb: Optional[Callable[[int], None]] = None,
     ) -> int:
         """ZPush (reference: kv_app.h:219). Response = 1 ack.
@@ -247,6 +255,9 @@ class KVWorker:
             iters=iters,
             num_merge=num_merge,
             party_nsrv=party_nsrv,
+            trace_round=trace_round,
+            trace_chunk=trace_chunk,
+            trace_origin=trace_origin,
         )
         self.po.van.send(_pack_kv(meta, kvs))
         return ts
@@ -263,6 +274,9 @@ class KVWorker:
         priority: int = 0,
         compr: str = "",
         aux: Optional[List] = None,
+        trace_round: int = -1,
+        trace_chunk: int = -1,
+        trace_origin: int = -1,
         cb: Optional[Callable[[int], None]] = None,
     ) -> int:
         """ZPull (reference: kv_app.h:324). ``cb`` receives the request
@@ -283,6 +297,9 @@ class KVWorker:
             pull=True,
             head=cmd,
             priority=priority,
+            trace_round=trace_round,
+            trace_chunk=trace_chunk,
+            trace_origin=trace_origin,
         )
         kvs = KVPairs(
             keys=list(keys),
@@ -448,6 +465,9 @@ def _req_meta_of(msg: Message) -> ReqMeta:
         num_merge=msg.meta.num_merge,
         party_nsrv=msg.meta.party_nsrv,
         epoch=msg.meta.epoch,
+        trace_round=msg.meta.trace_round,
+        trace_chunk=msg.meta.trace_chunk,
+        trace_origin=msg.meta.trace_origin,
     )
 
 
@@ -466,6 +486,11 @@ def _send_response(
         simple_app=req.simple_app,
         head=req.head,
         body=body,
+        # the response inherits the request's trace context so the ack
+        # leg of a round renders under the same round/chunk on the trace
+        trace_round=req.trace_round,
+        trace_chunk=req.trace_chunk,
+        trace_origin=req.trace_origin,
     )
     if kvs is not None:
         msg = _pack_kv(meta, kvs)

@@ -198,6 +198,17 @@ class Meta:
     # predates the sender's rejoin (zombie fencing)
     epoch: int = 0
 
+    # cross-node trace context (PR-7 telemetry): the worker stamps the
+    # round and chunk id at issue; the van stamps trace_origin (the
+    # first sender's id) once; servers COPY all three onto forwarded
+    # global-tier messages and responses, so one round's frames share
+    # one context worker -> local server -> global server -> worker and
+    # tools/trace_merge.py can stitch per-node dumps into one timeline.
+    # -1 = untraced (control / bootstrap traffic)
+    trace_round: int = -1
+    trace_chunk: int = -1
+    trace_origin: int = -1
+
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {}
         for f in dataclasses.fields(self):
@@ -251,7 +262,7 @@ class Meta:
 # version-mismatch ValueError at decode.
 # ---------------------------------------------------------------------------
 
-BINMETA_VERSION = 2
+BINMETA_VERSION = 3
 
 _META_FIELDS: List[Tuple[str, str]] = [
     ("sender", "i"), ("app_id", "i"), ("customer_id", "i"),
@@ -265,6 +276,7 @@ _META_FIELDS: List[Tuple[str, str]] = [
     ("tos", "i"), ("val_dtype", "s"), ("dgt_scale", "f"), ("dgt_n", "i"),
     ("lossy", "b"), ("num_merge", "i"), ("party_nsrv", "i"),
     ("aux_mask", "I"), ("aux_len", "i"), ("epoch", "i"),
+    ("trace_round", "i"), ("trace_chunk", "i"), ("trace_origin", "i"),
 ]
 _META_DEFAULTS = {f.name: ([] if isinstance(f.default,
                                             dataclasses._MISSING_TYPE)

@@ -15,6 +15,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from geomx_tpu import config as cfg_mod
+from geomx_tpu import telemetry
 from geomx_tpu.ps import base
 from geomx_tpu.ps import faults
 from geomx_tpu.ps.customer import Customer
@@ -71,6 +72,9 @@ class Postoffice:
             verbose=cfg.verbose,
             # GEOMX_WIRE_SANITIZER: per-van protocol-invariant checking
             wire_sanitizer=cfg.wire_sanitizer,
+            # GEOMX_FLIGHTREC_SIZE/_DIR: crash flight recorder ring
+            flightrec_size=cfg.flightrec_size,
+            flightrec_dir=cfg.flightrec_dir,
             # DGT runs on the inter-DC (global) tier only (reference:
             # StartGlobal binds the UDP channels, van.cc:613-646)
             dgt={
@@ -84,6 +88,11 @@ class Postoffice:
                 "grace_s": cfg.dgt_grace_ms / 1000.0,
             } if (is_global and cfg.enable_dgt) else None,
         )
+        # GEOMX_TELEMETRY/_DIR: the registry is process-wide; only push
+        # affirmative settings so several in-process nodes (simulate.
+        # InProcessHiPS) can't have the last default Config turn it off
+        telemetry.configure(enabled=True if cfg.telemetry else None,
+                            export_dir=cfg.telemetry_dir or None)
         self.van.msg_handler = self._dispatch
         self.van.give_up_handler = self._on_request_undeliverable
         self.van.on_membership = self._fire_membership

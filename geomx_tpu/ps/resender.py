@@ -41,6 +41,7 @@ import time
 from collections import OrderedDict, deque
 from typing import TYPE_CHECKING, Deque, Dict, Set, Tuple
 
+from geomx_tpu import telemetry
 from geomx_tpu.ps.message import Control, Message, Meta
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -133,6 +134,9 @@ class Resender:
         with self._lock:
             if sig in self._seen:
                 self.num_duplicates += 1
+                telemetry.counter_inc(
+                    "resender.duplicates",
+                    tier="global" if self.van.is_global else "local")
                 return True
             return False
 
@@ -246,6 +250,9 @@ class Resender:
             self._fire_give_ups(gave_up)
             for target, msg in to_resend:
                 self.num_resends += 1
+                telemetry.counter_inc(
+                    "resender.resends",
+                    tier="global" if self.van.is_global else "local")
                 try:
                     self.van._send_one(target, msg)
                 except OSError as e:

@@ -38,6 +38,7 @@ import logging
 import threading
 from typing import Dict, List, Tuple
 
+from geomx_tpu import telemetry
 from geomx_tpu.ps import dgt as dgt_mod
 
 log = logging.getLogger("geomx.sanitizer")
@@ -188,3 +189,13 @@ class WireSanitizer:
         self.violations.append(desc)
         log.error("%s [van %s] %s", MARKER,
                   getattr(self.van, "my_id", "?"), desc)
+        telemetry.event("sanitizer.violation", cat="sanitizer",
+                        node=getattr(self.van, "my_id", "?"), desc=desc)
+        telemetry.counter_inc("sanitizer.violations")
+        # a violation is exactly the moment the flight recorder exists
+        # for: dump the recent wire history (dedup by reason class keeps
+        # a cascade from rewriting the first, most interesting dump)
+        rec = getattr(self.van, "flightrec", None)
+        if rec is not None:
+            rec.record("violation", desc=desc)
+            rec.dump("violation:" + desc)

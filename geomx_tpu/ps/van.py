@@ -32,12 +32,13 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
-from geomx_tpu import profiler
+from geomx_tpu import profiler, telemetry
 from geomx_tpu.ps import base
 from geomx_tpu.ps import dgt as dgt_mod
 from geomx_tpu.ps import faults as faults_mod
 from geomx_tpu.ps import native as native_mod
 from geomx_tpu.ps import resender as resender_mod
+from geomx_tpu.ps.flightrec import FlightRecorder
 from geomx_tpu.ps.message import (Control, Message, Meta, Node, Role,
                                   read_message)
 
@@ -72,6 +73,8 @@ class Van:
         seed: Optional[int] = None,
         fault_plan: Optional["faults_mod.FaultPlan"] = None,
         wire_sanitizer: bool = False,
+        flightrec_size: int = 256,
+        flightrec_dir: str = "",
     ):
         self.my_role = my_role
         self.is_global = is_global
@@ -119,6 +122,11 @@ class Van:
         if wire_sanitizer:
             from geomx_tpu.ps.sanitizer import WireSanitizer
             self.sanitizer = WireSanitizer(self)
+        # crash flight recorder (GEOMX_FLIGHTREC_SIZE/_DIR): always-on
+        # bounded ring of recent wire/membership events, dumped when the
+        # van dies, a round aborts or the sanitizer flags a violation
+        self.flightrec = FlightRecorder(self.node_tag, size=flightrec_size,
+                                        out_dir=flightrec_dir)
         self.heartbeat_interval_s = heartbeat_interval_s
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.use_priority_send = use_priority_send
@@ -356,8 +364,12 @@ class Van:
         no barrier — indistinguishable from a process death to peers)
         and tell the owner via on_crash."""
         log.warning("%s crashing van: %s", self._tag(), reason)
-        profiler.instant("fault.crash", cat="fault",
-                         node=self.my_id, reason=reason)
+        telemetry.event("fault.crash", cat="fault",
+                        node=self.my_id, reason=reason)
+        # dump the ring BEFORE stop(): the last events are this van's
+        # view of the in-flight round at the moment of death
+        self.flightrec.record("crash", reason=reason)
+        self.flightrec.dump("crash:" + reason)
         cb = self.on_crash
         self.stop()
         if cb is not None:
@@ -375,6 +387,14 @@ class Van:
         the issuing customer so its wait() raises instead of blocking to
         its own timeout (round-2 advisor finding: resender.py gave up
         with only log.error)."""
+        telemetry.event("resender.give_up", cat="transport",
+                        node=self.my_id, target=target, reason=reason,
+                        mts=msg.meta.timestamp)
+        telemetry.counter_inc("resender.give_ups",
+                              tier="global" if self.is_global else "local")
+        self.flightrec.record("give_up", peer=target,
+                              ts=msg.meta.timestamp, reason=reason,
+                              round=msg.meta.trace_round)
         if msg.meta.request and msg.meta.timestamp >= 0:
             if self.sanitizer is not None:
                 self.sanitizer.on_give_up(msg)
@@ -473,6 +493,12 @@ class Van:
         # can fence stale senders (zombies / pre-rejoin traffic)
         if not msg.is_control and msg.meta.epoch == 0:
             msg.meta.epoch = self.membership_epoch
+        # traced frames carry the rank that first put them on a wire, so
+        # the merged cross-node trace can tell a worker's original push
+        # from the server's WAN re-issue of the same round
+        if (not msg.is_control and msg.meta.trace_round >= 0
+                and msg.meta.trace_origin < 0):
+            msg.meta.trace_origin = self.my_id
         targets = (
             base.expand_group(recver, self.num_workers, self.num_servers)
             if base.is_group(recver)
@@ -559,9 +585,30 @@ class Van:
             n = self._send_one_inner(target, msg)
             profiler.record(
                 "van.send", "transport", t0, profiler.now_us() - t0,
-                {"to": target, "bytes": n})
+                self._span_args(target, msg.meta, n))
             return n
         return self._send_one_inner(target, msg)
+
+    def _span_args(self, peer: int, meta: Meta, nbytes: int) -> dict:
+        """Args for van.send/van.recv spans. Carries everything
+        tools/trace_merge.py needs to pair the send on one node with the
+        recv on another: the overlay (``ovl`` — local tiers of different
+        parties reuse node ids), both endpoints, the request id and the
+        request/response direction. ``node`` identifies the emitting van
+        when several share one process-wide profiler (InProcessHiPS)."""
+        args = {
+            "node": self.node_tag(),
+            "ovl": f"{self.root_uri}:{self.root_port}:"
+                   f"{'g' if self.is_global else 'l'}",
+            "from": meta.sender, "to": peer,
+            "mts": meta.timestamp, "req": meta.request,
+            "verb": self._verb_of(meta), "bytes": nbytes,
+        }
+        if meta.trace_round >= 0:
+            args["round"] = meta.trace_round
+            args["chunk"] = meta.trace_chunk
+            args["origin"] = meta.trace_origin
+        return args
 
     def _send_one_inner(self, target: int, msg: Message) -> int:
         # send-side crash counting ("crash ... on: send" rules): the van
@@ -579,6 +626,8 @@ class Van:
             self._resender.assign_sig(msg)
             self._resender.add_outgoing(target, msg)
         buf = msg.pack()
+        if not msg.is_control:
+            self._note_wire("sent", target, msg.meta, len(buf))
         if self._native is not None:
             addr = self.node_table.get(target)
             if addr is None:
@@ -755,6 +804,17 @@ class Van:
             if whole is not None:
                 self._process(whole)
         else:
+            if not msg.is_control:
+                # approximate payload size: the exact framed length was
+                # accounted in recv_bytes by the reader; spans only need
+                # a comparable magnitude and the trace-context args
+                nbytes = sum(len(d) for d in msg.data)
+                self._note_wire("recv", msg.meta.sender, msg.meta, nbytes)
+                if profiler.is_running():
+                    t = profiler.now_us()
+                    profiler.record(
+                        "van.recv", "transport", t, 0,
+                        self._span_args(msg.meta.recver, msg.meta, nbytes))
             handler = self.msg_handler
             if handler is not None:
                 handler(msg)
@@ -1061,8 +1121,12 @@ class Van:
         log.warning("%s membership epoch %d: declaring %s dead (dead set "
                     "now %s)", self._tag(), epoch, sorted(fresh),
                     sorted(dead))
-        profiler.instant("membership.declare_dead", cat="membership",
-                         epoch=epoch, dead=sorted(dead))
+        telemetry.event("membership.declare_dead", cat="membership",
+                        epoch=epoch, dead=sorted(dead))
+        telemetry.gauge_set("membership.epoch", epoch,
+                            tier="global" if self.is_global else "local")
+        self.flightrec.record("membership", event="declare_dead",
+                              epoch=epoch, dead=sorted(dead))
         self._broadcast_membership(epoch, dead)
         self._membership_side_effects(epoch, dead)
 
@@ -1191,6 +1255,44 @@ class Van:
         """Log identity: tier, id, and bind port."""
         return (f"[{'g' if self.is_global else 'l'}"
                 f"/{self.my_id}@{getattr(self, 'my_port', '?')}]")
+
+    def node_tag(self) -> str:
+        """Filename-safe node identity for telemetry and flight-recorder
+        dumps: tier + id + overlay root port. The root port disambiguates
+        overlays that reuse the same id space (every party's local tier
+        numbers its workers/servers identically)."""
+        return (f"{'g' if self.is_global else 'l'}{self.my_id}"
+                f"p{self.root_port}")
+
+    @staticmethod
+    def _verb_of(meta: Meta) -> str:
+        if meta.push:
+            return "push"
+        if meta.pull:
+            return "pull"
+        if meta.simple_app:
+            return "command"
+        return "data"
+
+    def _note_wire(self, direction: str, peer: int, meta: Meta,
+                   nbytes: int) -> None:
+        """One wire event: flight-recorder ring entry + telemetry
+        counters labeled by tier/verb/codec. Called for non-control
+        frames only; both callers sit off the disabled-fast paths."""
+        verb = self._verb_of(meta)
+        if self.flightrec.enabled:
+            self.flightrec.record(
+                direction, peer=peer, verb=verb, bytes=nbytes,
+                req=meta.request, ts=meta.timestamp,
+                round=meta.trace_round, chunk=meta.trace_chunk,
+                origin=meta.trace_origin, epoch=meta.epoch)
+        if telemetry.enabled():
+            tier = "global" if self.is_global else "local"
+            codec = meta.compr or "raw"
+            telemetry.counter_inc(f"van.bytes_{direction}", nbytes,
+                                  tier=tier, verb=verb, codec=codec)
+            telemetry.counter_inc(f"van.messages_{direction}",
+                                  tier=tier, verb=verb, codec=codec)
 
     def _spawn(self, fn, name: str, *args) -> None:
         t = threading.Thread(target=fn, args=args, name=name, daemon=True)

@@ -21,16 +21,39 @@
 cd "$(dirname "$0")"
 SEED=${PS_SEED:-7}
 FAILED=0
+ARTIFACTS=""
+CASE_DIRS=()
+
+# On a failed case, gather everything a post-mortem needs into one
+# directory: the flight-recorder dumps (last wire events per van), the
+# per-round telemetry snapshots, and the process logs — /tmp/hips_*.log
+# is overwritten by the NEXT case, so they must be copied now.
+collect_artifacts() {
+  local name="$1" fdir="$2" tdir="$3"
+  [ -z "$ARTIFACTS" ] && ARTIFACTS=$(mktemp -d /tmp/chaos_artifacts.XXXXXX)
+  local dest="$ARTIFACTS/$name"
+  mkdir -p "$dest"
+  cp "$fdir"/flightrec_*.json "$dest"/ 2>/dev/null
+  cp "$tdir"/metrics_round*.json "$dest"/ 2>/dev/null
+  cp /tmp/hips_*.log "$dest"/ 2>/dev/null
+  echo "=== chaos[$name] artifacts: $dest ==="
+}
 
 run_case() {
   local name="$1" plan="$2" port_base="$3"; shift 3
   echo "=== chaos[$name] seed=$SEED ==="
+  # per-case flight-recorder/telemetry dirs (collected on failure;
+  # removed at the end of a fully green matrix)
+  LAST_FDIR=$(mktemp -d) LAST_TDIR=$(mktemp -d)
+  CASE_DIRS+=("$LAST_FDIR" "$LAST_TDIR")
   (
     export PS_SEED=$SEED
     export PS_FAULT_PLAN="$plan"
     # retransmit layer: short timeout so drops heal fast, an overall
     # delivery deadline so a wedged run fails loudly instead of hanging
     export PS_RESEND=1 PS_RESEND_TIMEOUT=500 PS_RESEND_DEADLINE=120
+    export GEOMX_FLIGHTREC_DIR=$LAST_FDIR
+    export GEOMX_TELEMETRY=1 GEOMX_TELEMETRY_DIR=$LAST_TDIR
     # distinct ports per case: no TIME_WAIT clashes between cases
     export GPORT=$port_base CPORT=$((port_base + 1)) \
            APORT=$((port_base + 2)) BPORT=$((port_base + 3))
@@ -44,6 +67,7 @@ run_case() {
     echo "=== chaos[$name] OK ==="
   else
     echo "=== chaos[$name] FAILED (re-run with PS_SEED=$SEED to reproduce) ==="
+    collect_artifacts "$name" "$LAST_FDIR" "$LAST_TDIR"
     FAILED=1
   fi
 }
@@ -78,6 +102,8 @@ unset GEOMX_OVERLAP P3_SLICE_BYTES GEOMX_WIRE_SANITIZER
 # overlap run's logs
 if grep -l "WIRE-SANITIZER VIOLATION" /tmp/hips_*.log 2>/dev/null; then
   echo "=== chaos[overlap] FAILED: wire-sanitizer violations (see logs above) ==="
+  # the sanitizer also triggered flight-recorder dumps — collect them
+  collect_artifacts overlap-sanitizer "$LAST_FDIR" "$LAST_TDIR"
   FAILED=1
 fi
 
@@ -100,10 +126,14 @@ unset PS_HEARTBEAT_INTERVAL PS_HEARTBEAT_TIMEOUT
 # replacement server then takes the dead slot (is_recovery) and
 # restores party A's state from the snapshot.
 echo "=== chaos[server-kill] seed=$SEED ==="
+LAST_FDIR=$(mktemp -d) LAST_TDIR=$(mktemp -d)
+CASE_DIRS+=("$LAST_FDIR" "$LAST_TDIR")
 (
   export PS_SEED=$SEED
   export PS_RESEND=1 PS_RESEND_TIMEOUT=500 PS_RESEND_DEADLINE=120
   export PS_HEARTBEAT_INTERVAL=1 PS_HEARTBEAT_TIMEOUT=3
+  export GEOMX_FLIGHTREC_DIR=$LAST_FDIR
+  export GEOMX_TELEMETRY=1 GEOMX_TELEMETRY_DIR=$LAST_TDIR
   export PS_SNAPSHOT_DIR=$(mktemp -d) PS_SNAPSHOT_INTERVAL=1
   # scoped via hips_env.sh so ONLY party A's server runs this plan — a
   # node/tier match alone also hits party B's server and the global
@@ -126,7 +156,11 @@ if [ $? -eq 0 ]; then
   echo "=== chaos[server-kill] OK ==="
 else
   echo "=== chaos[server-kill] FAILED (re-run with PS_SEED=$SEED to reproduce) ==="
+  collect_artifacts server-kill "$LAST_FDIR" "$LAST_TDIR"
   FAILED=1
 fi
+
+# a green matrix leaves nothing behind; a red one leaves $ARTIFACTS
+[ $FAILED -eq 0 ] && rm -rf "${CASE_DIRS[@]}"
 
 exit $FAILED

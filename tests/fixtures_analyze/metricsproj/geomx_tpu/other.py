@@ -1,0 +1,25 @@
+"""GX-M401 fixture: raw profiler events outside the telemetry funnel."""
+
+from geomx_tpu import profiler, telemetry
+
+
+class Thing:
+    def flag(self):
+        profiler.instant("thing.flagged", cat="test")  # GX-M401
+
+    def count(self, n):
+        profiler.counter("thing.count", n)  # GX-M401
+
+    def suppressed(self):
+        # geomx-lint: disable=GX-M401
+        profiler.instant("thing.quiet")
+
+    def clean(self):
+        telemetry.event("thing.flagged", cat="test")
+        telemetry.sample("thing.count", 3)
+        with profiler.scope("thing.work"):  # spans are trace-only: fine
+            pass
+
+
+def module_level():
+    profiler.instant("module.marker")  # GX-M401

@@ -1,0 +1,11 @@
+"""The funnel itself: raw profiler calls here are the implementation."""
+
+from geomx_tpu import profiler
+
+
+def event(name, cat="telemetry", **args):
+    profiler.instant(name, cat=cat, **args)  # exempt: this IS the funnel
+
+
+def sample(name, value, cat="telemetry"):
+    profiler.counter(name, value, cat=cat)  # exempt

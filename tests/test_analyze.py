@@ -23,8 +23,9 @@ import pytest
 
 from tools.analyze import (DEFAULT_BASELINE, load_baseline, load_sources,
                            run_all, run_concurrency, run_config_drift,
-                           run_protocol, run_traced, save_baseline,
-                           split_by_baseline, write_binmeta_lock)
+                           run_metrics, run_protocol, run_traced,
+                           save_baseline, split_by_baseline,
+                           write_binmeta_lock)
 from tools.analyze.config_drift import _expand_doc_shorthand
 from tools.analyze.protocol import (binmeta_lock_path, extract_meta_schema,
                                     meta_schema_fingerprint)
@@ -252,6 +253,35 @@ def test_committed_binmeta_lock_matches_tree():
     lock = json.loads(binmeta_lock_path(REPO).read_text(encoding="utf-8"))
     assert lock["version"] == version
     assert lock["fingerprint"] == meta_schema_fingerprint(fields)
+
+
+# ---------------------------------------------------------------------------
+# metrics pass (GX-M401)
+# ---------------------------------------------------------------------------
+
+def test_raw_profiler_event_fires():
+    root = FIXTURES / "metricsproj"
+    sources = load_sources([root / "geomx_tpu"], root)
+    hits = _by_rule(run_metrics(sources), "GX-M401")
+    got = {(h.symbol, h.detail) for h in hits}
+    # pre-suppression: the disable-commented site is still found here
+    assert got == {
+        ("Thing.flag", "profiler.instant:thing.flagged"),
+        ("Thing.count", "profiler.counter:thing.count"),
+        ("Thing.suppressed", "profiler.instant:thing.quiet"),
+        ("module_level", "profiler.instant:module.marker"),
+    }
+    # the funnel file itself and telemetry.event/sample callers, plus
+    # profiler.scope spans, all stay clean
+    assert all(h.path.endswith("other.py") for h in hits)
+
+
+def test_metrics_suppression_and_funnel_exemption():
+    root = FIXTURES / "metricsproj"
+    hits = _by_rule(run_all([root / "geomx_tpu"], root,
+                            passes=["metrics"]), "GX-M401")
+    assert {h.symbol for h in hits} == \
+        {"Thing.flag", "Thing.count", "module_level"}
 
 
 # ---------------------------------------------------------------------------

@@ -68,3 +68,20 @@ def test_hfa_within_gate_passes():
     assert bench.parity_violations(1.0, 1.0, 1.0, hfa_acc=0.985) == []
     # absent probe (old capture) does not gate
     assert bench.parity_violations(1.0, 1.0, 1.0, hfa_acc=None) == []
+
+
+def test_bsc_line_carries_wan_bytes_per_round():
+    """The canonical JSON line must surface the WAN-bytes figure when
+    the BSC phase measured one (the number ROADMAP item 2 gates on; the
+    value itself is cross-checked against the per-verb telemetry
+    counters in tests/test_telemetry.py)."""
+    bench = _load_bench()
+    bsc = {"img_s": 10.0, "acc": 0.99, "threshold": 0.02,
+           "trials": [1.0], "wan_bytes_per_round": 12345.6}
+    result, _ = bench._assemble({"hips_bsc": bsc})
+    assert result["details"]["hips_bsc_cnn"]["wan_bytes_per_round"] \
+        == 12345.6
+    # an old capture without the figure stays schema-stable
+    del bsc["wan_bytes_per_round"]
+    result, _ = bench._assemble({"hips_bsc": bsc})
+    assert "wan_bytes_per_round" not in result["details"]["hips_bsc_cnn"]

@@ -86,6 +86,89 @@ def test_remote_command_rank_prefixes_dump(tmp_path):
     assert any(e["name"] == "server_work" for e in doc["traceEvents"])
 
 
+def test_remote_command_malformed_json_is_ignored():
+    profiler.set_state("run")
+    profiler.apply_remote_command("{not json", rank=0)
+    profiler.apply_remote_command("", rank=0)
+    # state untouched: still running, scopes record
+    with profiler.scope("alive"):
+        pass
+    names = [e["name"] for e in json.loads(profiler.dumps())["traceEvents"]]
+    assert names == ["alive"]
+
+
+def test_remote_command_unknown_cmd_is_noop():
+    profiler.set_state("run")
+    profiler.apply_remote_command(json.dumps({"cmd": 99, "params": {}}), 0)
+    profiler.apply_remote_command(json.dumps({"params": {}}), 0)  # no cmd
+    assert profiler.is_running()
+
+
+def test_remote_state_defaults_to_stop():
+    profiler.set_state("run")
+    profiler.apply_remote_command(json.dumps({"cmd": profiler.CMD_STATE}), 0)
+    assert not profiler.is_running()
+
+
+def test_remote_pause_defaults_true_and_roundtrips():
+    profiler.set_state("run")
+    profiler.apply_remote_command(json.dumps({"cmd": profiler.CMD_PAUSE}), 0)
+    with profiler.scope("while_paused"):
+        pass
+    profiler.apply_remote_command(
+        json.dumps({"cmd": profiler.CMD_PAUSE,
+                    "params": {"paused": False}}), 0)
+    with profiler.scope("after_resume"):
+        pass
+    names = [e["name"] for e in json.loads(profiler.dumps())["traceEvents"]]
+    assert "while_paused" not in names and "after_resume" in names
+
+
+def test_remote_set_config_without_filename_keeps_default():
+    profiler.apply_remote_command(
+        json.dumps({"cmd": profiler.CMD_SET_CONFIG,
+                    "params": {"aggregate_stats": True}}), rank=5)
+    # no filename param -> nothing to rank-prefix, default stays
+    assert profiler._config["filename"] == "profile.json"
+    assert profiler._config["aggregate_stats"] is True
+
+
+def test_dump_is_atomic_leaves_no_tmp(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "t.json"))
+    profiler.set_state("run")
+    with profiler.scope("w"):
+        pass
+    profiler.dump()
+    assert [p.name for p in tmp_path.iterdir()] == ["t.json"]
+
+
+def test_interrupted_dump_preserves_previous_trace(tmp_path, monkeypatch):
+    """A dump that dies mid-write must not clobber an earlier good trace
+    — the crash flight path dumps into files other tools then read."""
+    target = tmp_path / "t.json"
+    profiler.set_config(filename=str(target))
+    profiler.set_state("run")
+    with profiler.scope("good"):
+        pass
+    profiler.dump(finished=False)
+    before = target.read_text()
+
+    real_open = open
+
+    def failing_open(path, *a, **kw):
+        if ".tmp." in str(path):
+            raise OSError("disk full")
+        return real_open(path, *a, **kw)
+
+    monkeypatch.setattr("builtins.open", failing_open)
+    with pytest.raises(OSError):
+        profiler.dump()
+    monkeypatch.undo()
+    assert target.read_text() == before
+    doc = json.loads(target.read_text())
+    assert any(e["name"] == "good" for e in doc["traceEvents"])
+
+
 def test_worker_drives_server_profiler_end_to_end(tmp_path):
     """A worker remotely configures, runs, and dumps the server's
     profiler; the dump lands rank-prefixed and contains server.push

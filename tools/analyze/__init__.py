@@ -16,6 +16,9 @@ accelerator):
   send/dispatch consistency, droppable requests, bare-key response
   routing, unfenced countdown mutations, static-count countdowns, and
   the binary-meta schema lock.
+- **metrics** (GX-M4xx): raw ``profiler.instant``/``profiler.counter``
+  calls outside the telemetry funnel (geomx_tpu/telemetry.py) — events
+  the metrics registry would silently miss.
 
 Run ``python -m tools.analyze`` from the repo root; see
 docs/static-analysis.md for the rule catalogue, baseline workflow and
@@ -32,13 +35,14 @@ from .core import (Finding, SEV_ERROR, SEV_WARNING, SourceFile,
                    save_baseline, sort_findings, split_by_baseline)
 from .concurrency import run_concurrency
 from .config_drift import run_config_drift
+from .metrics import run_metrics
 from .protocol import run_protocol, write_binmeta_lock
 from .traced import run_traced
 
 __all__ = [
     "Finding", "SEV_ERROR", "SEV_WARNING", "SourceFile",
     "run_concurrency", "run_traced", "run_config_drift", "run_protocol",
-    "run_all", "write_binmeta_lock",
+    "run_metrics", "run_all", "write_binmeta_lock",
     "load_baseline", "save_baseline", "split_by_baseline",
     "sort_findings", "DEFAULT_BASELINE",
 ]
@@ -50,6 +54,7 @@ PASSES = {
     "traced": lambda sources, root: run_traced(sources),
     "config-drift": run_config_drift,
     "protocol": run_protocol,
+    "metrics": lambda sources, root: run_metrics(sources),
 }
 
 

@@ -1,0 +1,83 @@
+"""Metrics-funnel pass: raw profiler events that bypass telemetry.
+
+Rule
+----
+GX-M401 (warning) ``profiler.instant(...)`` or ``profiler.counter(...)``
+called anywhere except ``telemetry.py``. PR-7 routed operational events
+through ``geomx_tpu.telemetry`` (``telemetry.event`` / ``telemetry.
+sample``), which forwards to the profiler AND feeds the metrics
+registry — a raw profiler call produces a trace marker that the metrics
+snapshot, ``kv.metrics()`` and the per-round exports never see, so
+dashboards silently undercount. ``profiler.record``/``scope`` (timed
+spans) stay first-class: spans are trace-only by design.
+
+The three ``replication.py`` instants predate the funnel and are
+accepted in the committed baseline; new code must use the funnel or
+carry an explicit ``geomx-lint: disable=GX-M401``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence
+
+from .core import Finding, SEV_WARNING, SourceFile, call_name, const_str
+
+_RAW_CALLS = {"profiler.instant", "profiler.counter"}
+
+
+def _index_functions(tree: ast.Module):
+    """(node, qualname) for every function, nested or method."""
+    out = []
+
+    def walk(node: ast.AST, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                out.append((child, q))
+                walk(child, f"{q}.<locals>.")
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}{child.name}.")
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out
+
+
+def _enclosing(fns, line: int) -> Optional[str]:
+    best = None
+    for node, q in fns:
+        if node.lineno <= line <= (node.end_lineno or node.lineno):
+            if best is None or node.lineno > best[0].lineno:
+                best = (node, q)
+    return best[1] if best else None
+
+
+def run_metrics(sources: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in sources:
+        if src.tree is None:
+            continue
+        # the funnel itself is the one legitimate raw caller
+        if src.rel.rsplit("/", 1)[-1] == "telemetry.py":
+            continue
+        fns = _index_functions(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            nm = call_name(node.func)
+            if nm not in _RAW_CALLS:
+                continue
+            evname = const_str(node.args[0]) if node.args else None
+            findings.append(Finding(
+                "GX-M401", SEV_WARNING, src.rel, node.lineno,
+                symbol=_enclosing(fns, node.lineno) or "<module>",
+                detail=f"{nm}:{evname or node.lineno}",
+                message=(f"{nm}"
+                         f"({evname!r}) " if evname else f"{nm}() ")
+                + ("bypasses the telemetry funnel — the event never "
+                   "reaches the metrics registry (kv.metrics(), "
+                   "per-round snapshots); use telemetry.event() / "
+                   "telemetry.sample() instead")))
+    return findings

@@ -1,0 +1,119 @@
+"""Render flight-recorder dumps as a readable crash narrative.
+
+``geomx_tpu.ps.flightrec`` dumps a bounded ring of recent wire and
+membership events as JSON when a van crashes, a sanitizer violation
+fires or a round aborts. This tool turns one or more dumps (or a whole
+``GEOMX_FLIGHTREC_DIR``) into the story a person actually wants at
+3am: who dumped, why, and what the last frames on the wire were — with
+trace rounds called out so the in-flight round is obvious.
+
+Usage::
+
+    python -m tools.flight_report /tmp/geomx_flightrec
+    python -m tools.flight_report flightrec_g8p9011_pid123.json --tail 40
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List
+
+
+def _fmt_time(t: float) -> str:
+    return time.strftime("%H:%M:%S", time.localtime(t)) + f".{int(t % 1 * 1000):03d}"
+
+
+def _fmt_event(ev: dict) -> str:
+    seq = ev.get("seq", "?")
+    kind = ev.get("kind", "?")
+    when = _fmt_time(ev["t"]) if "t" in ev else "?"
+    extras = {k: v for k, v in ev.items()
+              if k not in ("seq", "t", "kind")}
+    # wire events read as a sentence, the rest as key=value
+    if kind in ("sent", "recv"):
+        arrow = "->" if kind == "sent" else "<-"
+        line = (f"{extras.pop('verb', '?'):7s} {arrow} peer "
+                f"{extras.pop('peer', '?'):>3} "
+                f"{extras.pop('bytes', 0):>8}B")
+        rnd = extras.pop("round", -1)
+        if rnd is not None and rnd >= 0:
+            line += f"  round={rnd}"
+            chunk = extras.pop("chunk", -1)
+            if chunk is not None and chunk >= 0:
+                line += f" chunk={chunk}"
+            extras.pop("origin", None)
+        extras.pop("req", None)
+        extras.pop("ts", None)
+        tail = " ".join(f"{k}={v}" for k, v in sorted(extras.items()))
+        return f"  {seq:>6} {when} {kind:10s} {line}  {tail}".rstrip()
+    tail = " ".join(f"{k}={v}" for k, v in sorted(extras.items()))
+    return f"  {seq:>6} {when} {kind:10s} {tail}".rstrip()
+
+
+def report(doc: dict, tail: int = 0) -> str:
+    events = doc.get("events", [])
+    shown = events[-tail:] if tail else events
+    lines = [
+        f"flight recorder dump: node {doc.get('node', '?')} "
+        f"pid {doc.get('pid', '?')}",
+        f"  reason:    {doc.get('reason', '?')}",
+        f"  dumped at: "
+        f"{_fmt_time(doc['dumped_at']) if 'dumped_at' in doc else '?'}",
+        f"  events:    {len(events)}"
+        + (f" (showing last {len(shown)})" if tail and tail < len(events)
+           else ""),
+    ]
+    rounds = sorted({ev.get("round") for ev in events
+                     if ev.get("round", -1) is not None
+                     and ev.get("round", -1) >= 0})
+    if rounds:
+        lines.append(f"  rounds in flight: {rounds}")
+    lines.append("")
+    lines.extend(_fmt_event(ev) for ev in shown)
+    return "\n".join(lines)
+
+
+def _collect(paths: List[str]) -> List[str]:
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if f.startswith("flightrec_") and f.endswith(".json")))
+        else:
+            files.append(p)
+    return files
+
+
+def main(argv: List[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("paths", nargs="+",
+                   help="dump file(s) or a GEOMX_FLIGHTREC_DIR")
+    p.add_argument("--tail", type=int, default=0,
+                   help="show only the last N events per dump")
+    args = p.parse_args(argv)
+    files = _collect(args.paths)
+    if not files:
+        print("no flight recorder dumps found", file=sys.stderr)
+        return 1
+    rc = 0
+    for i, path in enumerate(files):
+        if i:
+            print()
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"unreadable dump {path}: {e}", file=sys.stderr)
+            rc = 1
+            continue
+        print(report(doc, tail=args.tail))
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

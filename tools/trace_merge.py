@@ -1,0 +1,210 @@
+"""Stitch per-node chrome-trace dumps into ONE cross-node round trace.
+
+Each GeoMX process dumps its own chrome-trace JSON (geomx_tpu.profiler)
+with van.send/van.recv transport spans stamped by ps/van.py:_span_args.
+Every process measures time on its OWN monotonic clock (profiler._t0 is
+per-process), so the dumps cannot be overlaid directly — a van.recv
+would routinely appear *before* the van.send that caused it.
+
+This tool:
+
+1. loads every input dump and splits events by ``args.node`` (an
+   InProcessHiPS run writes several nodes into one file; a real
+   deployment writes one node per file — both shapes are accepted; a
+   file whose events carry no ``node`` tag is treated as one anonymous
+   node named after the file);
+2. pairs each ``van.send`` on node A with the matching ``van.recv`` on
+   node B by the wire identity ``(ovl, from, to, mts, req)`` — the
+   overlay string disambiguates the local tiers of different parties,
+   which reuse node ids;
+3. estimates each node's clock offset to a reference node NTP-style:
+   for a request/response pair crossing the same link in both
+   directions, ``off ≈ (min(recv_B - send_A) - min(recv_A - send_B))/2``
+   cancels the (assumed symmetric) one-way latency. Minima over many
+   pairs reject queueing noise. Nodes reachable only via other nodes
+   get offsets by BFS accumulation along observed links;
+4. emits a single chrome-trace JSON where each node is a separate pid
+   (with ``process_name`` metadata so Perfetto labels the rows) and all
+   timestamps are shifted onto the reference node's clock — a round is
+   then visible end-to-end: worker push -> local server -> global
+   server -> responses flowing back.
+
+Usage::
+
+    python -m tools.trace_merge node0.json node1.json ... -o merged.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+# wire identity of one frame: (ovl, from, to, mts, req) — see
+# ps/van.py:_span_args. `req` keeps a request and its response (which
+# share ovl/mts and swap from/to) from pairing with each other's echo.
+WireKey = Tuple[str, int, int, int, bool]
+
+_PAIRABLE = ("van.send", "van.recv")
+
+
+def load_nodes(paths: List[str]) -> Dict[str, List[dict]]:
+    """Events grouped by node tag, from one or many dump files."""
+    nodes: Dict[str, List[dict]] = collections.defaultdict(list)
+    for path in paths:
+        with open(path) as f:
+            doc = json.load(f)
+        for ev in doc.get("traceEvents", []):
+            node = (ev.get("args") or {}).get("node")
+            if node is None:
+                node = os.path.splitext(os.path.basename(path))[0]
+            nodes[node].append(ev)
+    return dict(nodes)
+
+
+def _wire_key(ev: dict) -> WireKey | None:
+    a = ev.get("args") or {}
+    if "ovl" not in a or "mts" not in a:
+        return None
+    return (a["ovl"], a["from"], a["to"], a["mts"], bool(a.get("req")))
+
+
+def _link_deltas(nodes: Dict[str, List[dict]]):
+    """For every (sender_node, recver_node) link: min(recv_ts - send_ts)
+    over all frames observed crossing it. On synchronized clocks this
+    is the one-way latency; on skewed clocks it is latency + skew."""
+    sends: Dict[WireKey, Tuple[str, float]] = {}
+    recvs: Dict[WireKey, Tuple[str, float]] = {}
+    for node, evs in nodes.items():
+        for ev in evs:
+            if ev.get("name") not in _PAIRABLE:
+                continue
+            key = _wire_key(ev)
+            if key is None:
+                continue
+            # the send's wire time is its END (pack+write duration is
+            # on-node work, not flight time)
+            if ev["name"] == "van.send":
+                sends[key] = (node, ev["ts"] + ev.get("dur", 0))
+            else:
+                recvs[key] = (node, ev["ts"])
+    deltas: Dict[Tuple[str, str], float] = {}
+    matched = 0
+    for key, (snode, sts) in sends.items():
+        hit = recvs.get(key)
+        if hit is None:
+            continue
+        rnode, rts = hit
+        if rnode == snode:
+            continue  # loopback: same clock, no skew information
+        matched += 1
+        link = (snode, rnode)
+        d = rts - sts
+        if link not in deltas or d < deltas[link]:
+            deltas[link] = d
+    return deltas, matched
+
+
+def solve_offsets(nodes: Dict[str, List[dict]],
+                  reference: str | None = None):
+    """offset[node]: subtract from that node's timestamps to land on
+    the reference clock. NTP pairing per bidirectional link, BFS from
+    the reference for transitive reach."""
+    deltas, matched = _link_deltas(nodes)
+    # symmetric-link offset: delta(A->B) = lat + off_B - off_A and
+    # delta(B->A) = lat + off_A - off_B  =>  off_B - off_A =
+    # (delta(A->B) - delta(B->A)) / 2
+    rel: Dict[Tuple[str, str], float] = {}
+    for (a, b), d_ab in deltas.items():
+        d_ba = deltas.get((b, a))
+        if d_ba is None:
+            # one-directional link (e.g. a node that only ever
+            # responded after crash): assume zero one-way latency —
+            # biased, but keeps the node on the timeline
+            rel[(a, b)] = d_ab
+            rel[(b, a)] = -d_ab
+        else:
+            off = (d_ab - d_ba) / 2.0
+            rel[(a, b)] = off
+            rel[(b, a)] = -off
+    if reference is None:
+        reference = sorted(nodes)[0]
+    offsets: Dict[str, float] = {reference: 0.0}
+    frontier = [reference]
+    while frontier:
+        cur = frontier.pop()
+        for (a, b), off in rel.items():
+            if a == cur and b not in offsets:
+                offsets[b] = offsets[a] + off
+                frontier.append(b)
+    for node in nodes:
+        offsets.setdefault(node, 0.0)  # unreachable: best effort
+    return offsets, matched
+
+
+def merge(nodes: Dict[str, List[dict]],
+          reference: str | None = None) -> dict:
+    """One chrome-trace doc: pid per node, timestamps clock-aligned."""
+    offsets, matched = solve_offsets(nodes, reference)
+    out: List[dict] = []
+    for pid, node in enumerate(sorted(nodes)):
+        out.append({"name": "process_name", "ph": "M", "pid": pid,
+                    "args": {"name": node}})
+        out.append({"name": "process_sort_index", "ph": "M", "pid": pid,
+                    "args": {"sort_index": pid}})
+        off = offsets[node]
+        for ev in nodes[node]:
+            ev = dict(ev)
+            ev["pid"] = pid
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] - off
+            out.append(ev)
+    return {"traceEvents": out, "displayTimeUnit": "ms",
+            "metadata": {"clock_offsets_us": offsets,
+                         "matched_wire_pairs": matched}}
+
+
+def rounds_spanning(doc: dict) -> Dict[int, set]:
+    """round id -> set of node tags whose van spans carry it (the
+    acceptance probe: a round traced end-to-end touches worker, local
+    server and global tier nodes)."""
+    seen: Dict[int, set] = collections.defaultdict(set)
+    for ev in doc.get("traceEvents", []):
+        a = ev.get("args") or {}
+        if "round" in a and "node" in a:
+            seen[a["round"]].add(a["node"])
+    return dict(seen)
+
+
+def main(argv: List[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("dumps", nargs="+", help="per-node chrome-trace JSON")
+    p.add_argument("-o", "--output", default="merged_trace.json")
+    p.add_argument("--reference", default=None,
+                   help="node tag whose clock wins (default: first "
+                        "sorted node)")
+    args = p.parse_args(argv)
+    nodes = load_nodes(args.dumps)
+    if not nodes:
+        print("no trace events found", file=sys.stderr)
+        return 1
+    doc = merge(nodes, args.reference)
+    tmp = f"{args.output}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, args.output)
+    spans = rounds_spanning(doc)
+    print(f"merged {len(nodes)} node(s), "
+          f"{doc['metadata']['matched_wire_pairs']} wire pair(s) "
+          f"matched -> {args.output}")
+    for rid in sorted(spans):
+        print(f"  round {rid}: {len(spans[rid])} node(s) "
+              f"[{', '.join(sorted(spans[rid]))}]")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
