@@ -17,9 +17,14 @@ bare jax+optax step and swung 4.6x between driver captures):
    full dense weights/grads each round. Steady-state throughput is the
    MEDIAN of 3 trials of >=10s each plus a fixed-iteration accuracy
    probe (both configs).
-3. ``nokv``   — the same model/step single-chip with optax, no kvstore:
+3. ``hips_mesh`` — the mesh-party tier (``dist_sync_mesh``): 8 virtual
+   CPU devices split into 2 parties x 2-device meshes, intra-party
+   aggregation as a fused psum, one van worker per party. Reports
+   img/s plus ``intra_party_protocol_ms`` against the 9.5 ms
+   combined-wire floor (always CPU by construction).
+4. ``nokv``   — the same model/step single-chip with optax, no kvstore:
    the framework-overhead denominator and the accuracy-parity baseline.
-4. ``transformer_mfu`` — a 26M-param decoder-only transformer train step
+5. ``transformer_mfu`` — a 26M-param decoder-only transformer train step
    (bf16, seq 512) single-chip, dense and Pallas-flash attention,
    reported as model-FLOPs utilization against the chip's peak.
 
@@ -475,6 +480,169 @@ def bench_hips_bsc(threshold: float = 0.02, lr: float = 0.05,
         topo.stop()
 
 
+# PERF.md's instrumented vanilla round: ~9.5-9.9 ms of wire protocol per
+# round at the 10-key CNN layout even after binary-meta + combined-wire.
+# The mesh tier's claim is that the INTRA-PARTY share of that cost drops
+# below this floor because the aggregation is an XLA collective, not a
+# host PS hop — bench_hips_mesh measures it directly.
+COMBINED_WIRE_FLOOR_MS = 9.5
+
+
+def bench_hips_mesh(threshold: float = 0.02, lr: float = 0.05):
+    """The mesh-party tier (kvstore ``dist_sync_mesh``): each party's
+    workers form a JAX mesh, intra-party aggregation is a psum fused
+    into the jitted step, and ONE rank per party speaks the van to the
+    global tier. Topology: 8 virtual CPU devices split into 2 parties
+    x 2-device meshes (the ISSUE's CPU stand-in for per-DC ICI) — this
+    phase therefore ALWAYS runs on the CPU backend and self-reports
+    platform=cpu, even in a chip capture.
+
+    Reported next to img/s: ``intra_party_protocol_ms`` — the fenced
+    median of the party-mean collective over a gradient-sized stack
+    (the exact reduction GSPMD fuses into the step), measured on a
+    quiet machine before the topology starts so worker threads don't
+    pollute it. The acceptance bar is COMBINED_WIRE_FLOOR_MS: the
+    intra-party hop must cost less than the combined-wire PS round it
+    replaces. Accuracy/threshold/lr mirror bench_hips_bsc (same model,
+    same BSC machinery on the party-mean gradient)."""
+    # the mesh needs >=4 visible devices; force the virtual CPU device
+    # split BEFORE the backend initializes (no-op if the driver already
+    # set it, error out honestly if a backend with too few devices is
+    # already live)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < 4:
+        return {"error": f"mesh phase needs >=4 devices, backend came "
+                         f"up with {len(jax.devices())}"}
+
+    from examples.utils import build_model_and_step, eval_acc
+    from geomx_tpu import telemetry
+    from geomx_tpu.io import load_data
+    from geomx_tpu.parallel.mesh import (batch_sharded, make_party_mesh,
+                                         replicated)
+    from geomx_tpu.simulate import InProcessHiPS
+    from geomx_tpu.trainer_device import DeviceResidentTrainer
+
+    telemetry.enable(True)
+    # party batch = 2 members x BATCH_PER_WORKER (the wire configs'
+    # per-worker batch), sharded over the party's dp axis by the store
+    bs = 2 * BATCH_PER_WORKER
+    leaves0, _td, grad_step, eval_step = build_model_and_step(bs)
+
+    # --- intra-party protocol probe (quiet machine, no topology yet):
+    # a dp-sharded (party, total) gradient stack reduced to its
+    # replicated mean is the collective the fused step contains
+    total = sum(int(np.asarray(l).size) for l in leaves0)
+    probe_mesh = make_party_mesh(2, 0)
+    g_stack = jax.device_put(
+        np.random.RandomState(0).randn(2, total).astype(np.float32),
+        batch_sharded(probe_mesh))
+    party_mean = jax.jit(lambda g: jnp.mean(g, axis=0),
+                         out_shardings=replicated(probe_mesh))
+    jax.block_until_ready(party_mean(g_stack))  # compile
+    samples = []
+    for _ in range(30):
+        t0 = time.perf_counter()
+        jax.block_until_ready(party_mean(g_stack))
+        samples.append((time.perf_counter() - t0) * 1000.0)
+    intra_ms = statistics.median(samples)
+
+    topo = InProcessHiPS(num_parties=2, workers_per_party=2,
+                         party_mesh_size=2).start()
+    try:
+        rounds = [0, 0]
+        accs = [0.0, 0.0]
+        phases = [None, None]
+        stop_round = [None]
+        phase_b = threading.Event()
+        phase_a_done = [False, False]
+        compile_lock = threading.Lock()
+
+        def master_init(kv):
+            for idx, leaf in enumerate(leaves0):
+                kv.init(idx, np.array(leaf))
+            kv.wait()
+
+        def worker(kv):
+            widx = topo.workers.index(kv)
+            tr = DeviceResidentTrainer(
+                list(leaves0), kv, grad_step, threshold=threshold,
+                learning_rate=lr, momentum=0.0)
+            train_iter, test_iter, _, _ = load_data(bs, 2, widx)
+            # host arrays: _place_batch device_puts them onto the
+            # party's dp sharding (a committed single-device array
+            # would force a cross-party reshard first)
+            batches = [(np.asarray(X), np.asarray(y))
+                       for X, y in itertools.islice(train_iter,
+                                                    _probe_batches())]
+            with compile_lock:
+                tr.warmup(*batches[0])
+            for it in range(BSC_ACC_ITERS):
+                X, y = batches[it % len(batches)]
+                tr.step(X, y)
+            accs[widx] = eval_acc(test_iter, tr.leaves, eval_step)
+            timed = []
+            for j in range(5):
+                X, y = batches[j % len(batches)]
+                _loss, ph = tr.step_timed(X, y)
+                timed.append(ph)
+            phases[widx] = {k: round(statistics.median(
+                [p[k] for p in timed]), 2) for k in timed[0]}
+            phase_a_done[widx] = True
+            if all(phase_a_done):
+                phase_b.set()
+            i = 0
+            while stop_round[0] is None or rounds[widx] < stop_round[0]:
+                X, y = batches[i % len(batches)]
+                tr.step(X, y)
+                rounds[widx] += 1
+                i += 1
+
+        runner, runner_err = _spawn_hips_workers(topo, worker,
+                                                 master_init, phase_b)
+        if not phase_b.wait(900.0):
+            raise TimeoutError("mesh accuracy phase did not complete")
+        if runner_err:
+            raise runner_err[0]
+        time.sleep(2.0)
+        # per-round byte deltas over the measured window: WAN bytes
+        # (tier=global van sends) and mesh collective bytes (tier=mesh
+        # ring model) live in DISJOINT counter families — the mesh tier
+        # must add zero to the WAN bill
+        snap0 = telemetry.snapshot()
+        wan0 = telemetry.wan_bytes(snap0)
+        mesh0 = telemetry.mesh_bytes(snap0)
+        fsa0 = rounds[0]
+        per_trial = _measure_trials(lambda: rounds[0] + rounds[1],
+                                    runner_err, bs)
+        snap1 = telemetry.snapshot()
+        nrounds = max(rounds[0] - fsa0, 1)
+        wan_per_round = (telemetry.wan_bytes(snap1) - wan0) / nrounds
+        mesh_per_round = (telemetry.mesh_bytes(snap1) - mesh0) / nrounds
+        stop_round[0] = max(rounds) + 2
+        runner.join(120.0)
+        return {"img_s": statistics.median(per_trial),
+                "acc": float(min(accs)),
+                "threshold": threshold,
+                "phases": phases[0],
+                "intra_party_protocol_ms": round(intra_ms, 3),
+                "wire_floor_ms": COMBINED_WIRE_FLOOR_MS,
+                "below_wire_floor": bool(intra_ms <
+                                         COMBINED_WIRE_FLOOR_MS),
+                "wan_bytes_per_round": round(wan_per_round, 1),
+                "mesh_bytes_per_round": round(mesh_per_round, 1),
+                "trials": [round(x, 1) for x in per_trial],
+                "platform": "cpu"}
+    finally:
+        topo.stop()
+
+
 def bench_hips_hfa(hfa_k1: int = 4, hfa_k2: int = 2):
     """HFA flavor of the framework bench: workers take K1 LOCAL optimizer
     steps per LAN sync, and the party tier crosses the WAN only every K2
@@ -831,6 +999,7 @@ PHASES = {
     "nokv": (bench_nokv, 900, False),
     "hips": (bench_hips, 900, False),
     "hips_bsc": (bench_hips_bsc, 900, False),
+    "hips_mesh": (bench_hips_mesh, 900, False),
     "hips_hfa": (bench_hips_hfa, 600, False),
     # MFU rows precede transformer_bsc: they are ~3-5 min each on a
     # healthy tunnel, while the 59M two-worker bootstrap can eat 10-20
@@ -995,6 +1164,25 @@ def _assemble(data: dict):
                 bsc["wan_bytes_per_round"]
     else:
         details["hips_bsc_cnn"] = bsc or {"error": "not run"}
+    mesh = data.get("hips_mesh")
+    if ok(mesh):
+        details["hips_mesh_cnn"] = {
+            "img_s": round(mesh["img_s"], 1),
+            f"acc_at_{BSC_ACC_ITERS}_iters": round(mesh["acc"], 4),
+            "threshold": mesh["threshold"],
+            # the tentpole number: the intra-party hop as a device
+            # collective vs the combined-wire PS round it replaces
+            "intra_party_protocol_ms": mesh["intra_party_protocol_ms"],
+            "wire_floor_ms": mesh["wire_floor_ms"],
+            "below_wire_floor": mesh["below_wire_floor"],
+            "trials": mesh["trials"]}
+        if mesh.get("phases"):
+            details["hips_mesh_cnn"]["round_phases_ms"] = mesh["phases"]
+        for k in ("wan_bytes_per_round", "mesh_bytes_per_round"):
+            if mesh.get(k):
+                details["hips_mesh_cnn"][k] = mesh[k]
+    else:
+        details["hips_mesh_cnn"] = mesh or {"error": "not run"}
     parity_failures = []
     if ok(nokv) and ok(bsc):
         details["bsc_accuracy_parity"] = round(

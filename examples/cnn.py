@@ -63,6 +63,12 @@ def main():
         if kv.is_master_worker:
             kv.set_optimizer(gx_opt.DCASGD(learning_rate=args.learning_rate))
     else:
+        # GEOMX_PARTY_MESH=1 resolves this to the mesh-party tier
+        # (kvstore "dist_sync_mesh", docs/mesh-party.md): the launch is
+        # unchanged, intra-party aggregation moves into the jitted
+        # step's psum, and only this process's van speaks to the party
+        # server. The factory does the resolution so scripts/run_*.sh
+        # stay identical either way.
         kv = gx.kv.create("dist_sync")
         if kv.is_master_worker:
             kv.set_optimizer(gx_opt.Adam(learning_rate=args.learning_rate))

@@ -227,6 +227,18 @@ class Config:
     # (the barrier moves to the point of first use, not away)
     overlap: bool = True                # GEOMX_OVERLAP
 
+    # ---- mesh-party tier (ours; docs/mesh-party.md) ----
+    # form a GSPMD party mesh over the local devices and aggregate
+    # intra-party gradients with a psum fused into the jitted train
+    # step instead of the LAN PS hop; the van then carries only the
+    # single global worker's traffic to the WAN tier. With this on,
+    # kv.create("dist_sync") behaves as "dist_sync_mesh".
+    party_mesh: bool = False            # GEOMX_PARTY_MESH
+    # devices per party mesh; 0 = every local device. On a shared host
+    # (tests/bench: 8 virtual CPU devices, 2 parties) each party takes
+    # a disjoint slice of this size
+    party_mesh_size: int = 0            # GEOMX_PARTY_MESH_SIZE
+
     # ---- TPU-specific ----
     van_type: str = "auto"              # GEOMX_VAN in {auto, python, native}
     platform: str = ""                  # GEOMX_PLATFORM override for jax
@@ -322,6 +334,8 @@ def load() -> Config:
         op_timeout_s=env_float("PS_OP_TIMEOUT", 300.0),
         p3_slice_bytes=env_int("P3_SLICE_BYTES", 0),
         overlap=env_bool("GEOMX_OVERLAP", True),
+        party_mesh=env_bool("GEOMX_PARTY_MESH"),
+        party_mesh_size=env_int("GEOMX_PARTY_MESH_SIZE", 0),
         van_type=env_str("GEOMX_VAN", "auto"),
         platform=env_str("GEOMX_PLATFORM"),
     )

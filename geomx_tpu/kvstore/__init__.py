@@ -11,6 +11,11 @@ python/mxnet/kvstore.py:663 create. Accepted type strings:
 - "dist" / "dist_sync" / "dist_sync_device" / "dist_sync_tpu"
                                 — distributed, FSA (both tiers synchronous)
 - "dist_async"                  — distributed, MixedSync (async global tier)
+- "dist_sync_mesh"              — mesh-party tier: intra-party aggregation
+                                  is a GSPMD psum inside the jitted step;
+                                  one global worker per party speaks the
+                                  van (kvstore.mesh_party). GEOMX_PARTY_MESH
+                                  makes the plain dist names resolve here.
 
 The "_tpu" suffix is accepted for parity with the driver's target config
 string; device-level aggregation on TPU happens inside jitted train steps
@@ -19,6 +24,7 @@ string; device-level aggregation on TPU happens inside jitted train steps
 
 from __future__ import annotations
 
+from geomx_tpu import config as cfg_mod
 from geomx_tpu.kvstore.base import Command, KVStore  # noqa: F401
 from geomx_tpu.kvstore.local import KVStoreLocal  # noqa: F401
 
@@ -26,11 +32,16 @@ from geomx_tpu.kvstore.local import KVStoreLocal  # noqa: F401
 def create(name: str = "local") -> KVStore:
     tname = name.lower()
     if "dist" in tname:
-        from geomx_tpu.kvstore.dist import KVStoreDist
-
         sync_global = "_sync" in tname or tname == "dist"
         if "_async" in tname:
             sync_global = False
+        if "_mesh" in tname or (sync_global
+                                and cfg_mod.load().party_mesh):
+            from geomx_tpu.kvstore.mesh_party import KVStorePartyMesh
+
+            return KVStorePartyMesh(sync_global=sync_global)
+        from geomx_tpu.kvstore.dist import KVStoreDist
+
         return KVStoreDist(sync_global=sync_global)
     if tname == "nccl":
         from geomx_tpu.kvstore.device import KVStoreDeviceAllreduce

@@ -200,6 +200,16 @@ class KVStoreDist(KVStore):
         rec = self.po.van.flightrec
         rec.record("note", event="round_abort", reason=reason[:200])
         rec.dump("round_abort")
+        # mesh-party fan-out (kvstore.mesh_party): the wrapping store
+        # fails every pending key of every live future so mesh ranks
+        # joining other keys unblock immediately instead of waiting out
+        # op_timeout on a round that cannot complete
+        hook = getattr(self, "round_abort_hook", None)
+        if hook is not None:
+            try:
+                hook(reason)
+            except Exception:  # noqa: BLE001 — never mask the round error
+                pass
 
     # -- helpers ---------------------------------------------------------
 

@@ -192,6 +192,22 @@ class RoundFuture:
         for fn in cbs:
             fn(key)
 
+    def abort_pending(self, reason: str) -> None:
+        """Fail every still-pending key with ``reason`` and wake all
+        joiners NOW. Used when the round is known dead as a whole (the
+        store's abort path, a mesh party whose global worker saw the
+        van round collapse): without it, joiners sit out the full
+        ``wait()`` timeout on keys that can never complete — exactly
+        the hang the mesh ranks must not suffer."""
+        with self._cv:
+            pending = list(self._pending)
+            for k in pending:
+                self._errors.setdefault(k, []).append(reason)
+                self._pending.discard(k)
+                self._results.setdefault(k, None)
+                self._callbacks.pop(k, None)
+            self._cv.notify_all()
+
     def _abort(self, reason: str) -> None:
         """Best-effort abort hook; never lets a hook failure mask the
         round's own error."""

@@ -46,6 +46,33 @@ def make_mesh(devices: Optional[Sequence] = None, tp: int = 1,
     return Mesh(arr, AXES if len(shape) == 3 else AXES5)
 
 
+def party_devices(party_size: int = 0, party_index: int = 0,
+                  devices: Optional[Sequence] = None) -> Sequence:
+    """Disjoint device slice for one party's mesh.
+
+    party_size=0 means "all local devices" (single party per host, the
+    production case). A nonzero size carves devices[i*size:(i+1)*size],
+    which is how tests/bench run several parties on one host's virtual
+    device set.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if party_size <= 0:
+        return list(devices)
+    lo = party_index * party_size
+    hi = lo + party_size
+    assert hi <= len(devices), (
+        f"party {party_index} needs devices [{lo}:{hi}) but only "
+        f"{len(devices)} are visible")
+    return list(devices[lo:hi])
+
+
+def make_party_mesh(party_size: int = 0, party_index: int = 0,
+                    devices: Optional[Sequence] = None) -> Mesh:
+    """Pure-dp mesh over one party's device slice (mesh-party tier)."""
+    return make_mesh(party_devices(party_size, party_index, devices))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

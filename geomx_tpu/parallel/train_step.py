@@ -91,6 +91,13 @@ class HierarchicalTrainer:
         self.t = trainer
         self.kv = kvstore
         self.priority_by_key = priority_by_key
+        # mesh-party store (kvstore.mesh_party): the trainer's mesh IS
+        # the party — grads() already carries the intra-party psum, so
+        # the van round shrinks to the global worker's combined
+        # push_pull and the fresh params broadcast back via _install
+        # (a replicated device_put, no LAN PS hop)
+        self._mesh_store = getattr(kvstore, "mesh", None) is not None \
+            and hasattr(kvstore, "record_round_collectives")
         leaves, self.treedef = jax.tree_util.tree_flatten(self.t.params)
         self._shapes = [l.shape for l in leaves]
         self._host = [np.array(l, copy=True) for l in leaves]
@@ -111,10 +118,31 @@ class HierarchicalTrainer:
     def step(self, X, y) -> float:
         loss, grads = self.t.grads(X, y)
         glist = jax.tree_util.tree_leaves(grads)
+        if self._mesh_store:
+            return self._step_mesh(glist, loss)
         for idx, g in enumerate(glist):
             pr = -idx if self.priority_by_key else 0
             self.kv.push(idx, np.asarray(g), priority=pr)
             self.kv.pull(idx, out=self._host[idx], priority=pr)
         self.kv.wait()
+        self._install()
+        return float(loss)
+
+    def _step_mesh(self, glist, loss) -> float:
+        """Mesh-party round: the intra-party aggregation already
+        happened inside grads() (the psum XLA inserts for the
+        dp-sharded mean loss) — account it under tier=mesh, then only
+        the party's global worker puts bytes on the van (one combined
+        push_pull round); the result broadcasts back into the mesh as
+        a replicated device_put."""
+        self.kv.record_round_collectives(glist)
+        if self.kv.is_global_worker:
+            vals = [np.asarray(g) for g in glist]
+            if len(vals) == 1:
+                self.kv.push_pull(0, vals[0], self._host[0], priority=0)
+            else:
+                self.kv.push_pull(list(range(len(vals))), vals,
+                                  self._host, priority=0)
+            self.kv.wait()
         self._install()
         return float(loss)

@@ -53,11 +53,18 @@ class InProcessHiPS:
                  sync_global: bool = True, use_hfa: bool = False,
                  hfa_k2: int = 1, enable_central_worker: bool = False,
                  bigarray_bound: int = 1_000_000,
+                 party_mesh_size: int = 0,
                  extra_cfg: Optional[dict] = None):
         self.gport = free_port()
         self.cports = [free_port() for _ in range(num_parties + 1)]
         self.num_parties = num_parties
         self.wpp = workers_per_party
+        # mesh-party tier (kvstore.mesh_party): each party's workers
+        # collapse into ONE KVStorePartyMesh over a disjoint slice of
+        # ``party_mesh_size`` local devices — the van sees one worker
+        # per party, intra-party aggregation is a device psum
+        self.pms = int(party_mesh_size)
+        self.van_wpp = 1 if self.pms > 0 else self.wpp
         self.ngs = num_global_servers
         # servers_per_party: an int (uniform) or a per-party list —
         # non-uniform topologies need cfg.num_parties for exact FSA
@@ -69,7 +76,11 @@ class InProcessHiPS:
             assert len(self.spp_list) == num_parties
         self.spp = self.spp_list[0]
         self.ngw = sum(self.spp_list)
-        self.num_all = num_parties * workers_per_party
+        # in mesh mode the global tier sums one aggregate per party, so
+        # the cross-party trainer count the wire scaling sees is the
+        # party count, not members x parties
+        self.num_all = (num_parties if self.pms > 0
+                        else num_parties * workers_per_party)
         self.bigarray_bound = bigarray_bound
         self.use_hfa = use_hfa
         self.hfa_k2 = hfa_k2
@@ -160,16 +171,36 @@ class InProcessHiPS:
         for p in range(self.num_parties):
             port = self.cports[p + 1]
             spp = self.spp_list[p]
-            self._spawn(self._run_sched, port, False, self.wpp, spp)
+            self._spawn(self._run_sched, port, False, self.van_wpp, spp)
             for _ in range(spp):
                 cfg = self._common(
                     role="server",
                     ps_root_uri="127.0.0.1", ps_root_port=port,
-                    num_workers=self.wpp, num_servers=spp,
+                    num_workers=self.van_wpp, num_servers=spp,
                 )
                 srv = KVStoreDistServer(cfg)
                 self.servers.append(srv)
                 self._spawn(srv.run)
+            if self.pms > 0:
+                # mesh party: ONE van worker — the party's global
+                # worker — over the party's device slice; the mesh is
+                # built here (main thread owns jax.devices())
+                from geomx_tpu.kvstore.mesh_party import KVStorePartyMesh
+                from geomx_tpu.parallel.mesh import make_party_mesh
+
+                wcfg = self._common(
+                    role="worker", party_mesh=True,
+                    party_mesh_size=self.pms,
+                    ps_root_uri="127.0.0.1", ps_root_port=port,
+                    num_workers=1, num_servers=spp,
+                )
+                mesh = make_party_mesh(self.pms, p)
+                box: list = []
+                worker_boxes.append(box)
+                self._spawn(lambda b=box, c=wcfg, m=mesh: b.append(
+                    KVStorePartyMesh(sync_global=self.sync_global,
+                                     cfg=c, mesh=m)))
+                continue
             for _ in range(self.wpp):
                 wcfg = self._common(
                     role="worker",

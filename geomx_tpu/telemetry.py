@@ -209,6 +209,21 @@ def wan_bytes(snap: Optional[Dict[str, Any]] = None) -> float:
     return total
 
 
+def mesh_bytes(snap: Optional[Dict[str, Any]] = None) -> float:
+    """Total bytes moved by mesh-party device collectives in ``snap``
+    (default: the live registry). These live under their own counter
+    family (``mesh.bytes{tier=mesh,...}``) precisely so
+    :func:`wan_bytes` — which matches ``van.bytes_sent{...tier=global``
+    only — can never absorb them."""
+    if snap is None:
+        snap = snapshot()
+    total = 0.0
+    for key, v in snap.get("counters", {}).items():
+        if key.startswith("mesh.bytes{") and "tier=mesh" in key:
+            total += v
+    return total
+
+
 def reset() -> None:
     global _enabled, _export_dir
     with _lock:

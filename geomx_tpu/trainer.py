@@ -126,6 +126,16 @@ class Trainer:
         self._join()
 
     def _issue(self, garr: List[np.ndarray], pull: bool) -> None:
+        if (getattr(self.kv, "type", "") == "dist_sync_mesh" and pull
+                and len(garr) > 1):
+            # mesh-party store: the gradients handed in are already the
+            # party aggregate (psummed in the caller's jitted step) —
+            # account that collective under tier=mesh and run ONE
+            # combined van round from the global worker
+            self.kv.record_round_collectives(garr)
+            keys = [self.begin_key + i for i in range(len(garr))]
+            self.kv.push_pull(keys, list(garr), self._leaves, priority=0)
+            return
         for i, g in enumerate(garr):
             prio = -i if self.priority_descending else 0
             key = self.begin_key + i

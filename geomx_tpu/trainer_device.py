@@ -84,6 +84,15 @@ class DeviceResidentTrainer:
         self.threshold = threshold
         self.learning_rate = learning_rate
         self.momentum = momentum
+        # mesh-party store (kvstore.mesh_party): trainer state lives
+        # replicated on the party mesh, batches shard over "dp", and
+        # grad_fn's mean-loss backward gets an XLA-inserted psum — the
+        # party's aggregation happens inside the jitted step, so the
+        # BSC selection below runs on the party-MEAN gradient and the
+        # van carries one worker's traffic per party. num_all_workers
+        # is then the number of parties, so the g/nw scaling already
+        # matches the wire path's per-member scaling.
+        self._mesh = getattr(kvstore, "mesh", None)
 
         leaves = [np.asarray(p, np.float32) for p in params]
         self._shapes = [l.shape for l in leaves]
@@ -109,11 +118,18 @@ class DeviceResidentTrainer:
                 self.kv.pull(begin_key + i, out=leaves[i])
         self.kv.wait()
 
+        repl = (kvstore.replicated_sharding() if self._mesh is not None
+                else None)
+
+        def dput(x):
+            return jax.device_put(x, repl) if repl is not None \
+                else jax.device_put(x)
+
         flat0 = np.concatenate([l.ravel() for l in leaves])
-        self._flat = jax.device_put(jnp.asarray(flat0))
-        self._u = jax.device_put(jnp.zeros(self.total, jnp.float32))
-        self._v = jax.device_put(jnp.zeros(self.total, jnp.float32))
-        self._mom = (jax.device_put(jnp.zeros(self.total, jnp.float32))
+        self._flat = dput(jnp.asarray(flat0))
+        self._u = dput(jnp.zeros(self.total, jnp.float32))
+        self._v = dput(jnp.zeros(self.total, jnp.float32))
+        self._mom = (dput(jnp.zeros(self.total, jnp.float32))
                      if momentum else None)
 
         shapes = self._shapes
@@ -250,6 +266,29 @@ class DeviceResidentTrainer:
             self._fwd_chunks = fwd_chunks
             self._apply_chunk = apply_chunk
 
+    def _place_batch(self, X, y):
+        """Mesh mode: shard the batch over the party's dp axis (the
+        psum in grad_fn's backward then aggregates across mesh ranks);
+        elsewhere a no-op. Mesh rounds must run on the party's global
+        worker — it is the only rank allowed to materialize host
+        arrays (GX-J104) and speak the van."""
+        if self._mesh is None:
+            return X, y
+        if not getattr(self.kv, "is_global_worker", True):
+            raise RuntimeError(
+                "DeviceResidentTrainer mesh rounds drive the party "
+                "from its global worker; non-global mesh ranks hold "
+                "no host-side round state")
+        return self.kv.shard_batch(X, y)
+
+    def _count_mesh_round(self) -> None:
+        """Account one round's intra-party collective volume: the dp
+        psum XLA inserts in grad_fn's backward moves the dense fp32
+        gradient once per round (counted from shape — tier=mesh, so
+        telemetry.wan_bytes() stays honest)."""
+        if self._mesh is not None:
+            self.kv.count_collective(self.total * 4)
+
     def warmup(self, X, y) -> None:
         """Trace+compile both device steps WITHOUT running a kv round
         (results discarded, trainer state untouched) — lets callers
@@ -257,6 +296,7 @@ class DeviceResidentTrainer:
         barrier."""
         import jax
 
+        X, y = self._place_batch(X, y)
         packed, _u, _v = self._fwd_compress(self._flat, self._u,
                                             self._v, X, y)
         up = jax.device_put(np.zeros(2 * self._up_cap, np.int32))
@@ -285,6 +325,8 @@ class DeviceResidentTrainer:
         same post-round state, overlapped wall clock."""
         import jax
 
+        X, y = self._place_batch(X, y)
+        self._count_mesh_round()
         if self._pipeline:
             return self._step_pipelined(X, y)
         packed_d, self._u, self._v = self._fwd_compress(
@@ -401,6 +443,8 @@ class DeviceResidentTrainer:
         import jax
 
         assert self._sparse_wire, "step_timed needs the sparse wire"
+        X, y = self._place_batch(X, y)
+        self._count_mesh_round()
         t0 = time.perf_counter()
         if self._pipeline:
             loss_d, packs, self._u, self._v = self._fwd_chunks(

@@ -1,0 +1,59 @@
+"""GX-J104 fixture: host transfers on mesh-party round paths.
+
+``PartyMeshStore`` violates the rule three ways (direct, transitive,
+and via jax.device_get); ``CleanMeshStore`` shows every guard shape
+that must stay clean; ``PlainWireStore`` proves the rule keys on the class
+name.
+"""
+
+import numpy as np
+
+import jax
+
+
+class PartyMeshStore:
+    def push_round(self, glist):
+        # VIOLATION: every mesh rank would materialize the gradient
+        vals = [np.asarray(g) for g in glist]
+        return vals
+
+    def pull_results(self, out):
+        # VIOLATION (transitive): reached from a round-shaped method
+        return self._fetch(out)
+
+    def _fetch(self, out):
+        return jax.device_get(out)
+
+    def step(self, x):
+        # VIOLATION: first addressable shard fetched on every rank
+        return np.array(x.addressable_data(0))
+
+    def close(self):
+        # not a round-shaped method: never scanned
+        return np.asarray([0.0])
+
+
+class CleanMeshStore:
+    def __init__(self):
+        self.is_global_worker = True
+
+    def push_round(self, glist):
+        if self.is_global_worker:
+            return [np.asarray(g) for g in glist]    # guarded: clean
+        return None
+
+    def pull_round(self, out):
+        if not self.is_global_worker:
+            raise RuntimeError("van is global-worker only")
+        return np.asarray(out)                        # fenced: clean
+
+    def record_round(self, leaves):
+        # shape metadata only — no host transfer at all
+        return sum(int(getattr(x, "nbytes", 0)) for x in leaves)
+
+
+class PlainWireStore:
+    def push_round(self, glist):
+        # same body as the violation above, but the class is not
+        # Mesh-named — out of the rule's scope
+        return [np.asarray(g) for g in glist]

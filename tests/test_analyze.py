@@ -120,6 +120,26 @@ def test_missing_donate_fires(traced_findings):
     # donated, non-state-returning, and static functions all stay clean
 
 
+def test_mesh_host_transfer_fires():
+    """GX-J104: unguarded host transfers on round-shaped methods of
+    Mesh-named classes fire — directly, transitively, and for
+    .addressable_data — while is_global_worker-guarded forms, fenced
+    early exits, non-round methods, and non-Mesh classes stay clean."""
+    sources = load_sources([FIXTURES / "mesh_bad.py"], FIXTURES)
+    hits = _by_rule(run_traced(sources), "GX-J104")
+    syms = {h.symbol for h in hits}
+    assert "PartyMeshStore.push_round" in syms
+    assert "PartyMeshStore.step" in syms
+    # transitive: pull_results -> _fetch -> jax.device_get
+    assert any(h.symbol == "PartyMeshStore._fetch"
+               and "jax.device_get" in h.detail for h in hits)
+    # guarded / fenced / out-of-scope symbols never fire
+    assert all(not h.symbol.startswith("CleanMeshStore") for h in hits)
+    assert all(not h.symbol.startswith("PlainWireStore") for h in hits)
+    assert all(h.symbol != "PartyMeshStore.close" for h in hits)
+    assert all(h.severity == "error" for h in hits)
+
+
 # ---------------------------------------------------------------------------
 # config-drift pass (GX-C201..C204)
 # ---------------------------------------------------------------------------

@@ -158,6 +158,41 @@ def test_wan_bytes_sums_global_send_counters_only():
     assert telemetry.wan_bytes(snap) == manual
 
 
+def test_wan_bytes_excludes_mesh_tier_counters():
+    """The mesh-party tier's device collectives (kvstore.mesh_party)
+    live under their own counter family: wan_bytes() must never count
+    them — they cross ICI inside one DC, not the WAN — and
+    mesh_bytes() must count exactly them."""
+    telemetry.enable(True)
+    telemetry.counter_inc("van.bytes_sent", 100, tier="global", verb="push",
+                          codec="raw")
+    telemetry.counter_inc("mesh.bytes", 4096, tier="mesh", op="psum")
+    telemetry.counter_inc("mesh.bytes", 512, tier="mesh", op="all_gather")
+    telemetry.counter_inc("mesh.messages", 2, tier="mesh", op="psum")
+    snap = telemetry.snapshot()
+    assert telemetry.wan_bytes(snap) == 100
+    assert telemetry.mesh_bytes(snap) == 4608
+    # and the families are disjoint by construction
+    assert telemetry.wan_bytes(snap) + telemetry.mesh_bytes(snap) == 4708
+
+
+def test_mesh_store_count_collective_counter_family():
+    """KVStorePartyMesh.count_collective books ring-model bytes
+    (2*(P-1)*nbytes) under tier=mesh only, plus a message count."""
+    from geomx_tpu.kvstore.mesh_party import KVStorePartyMesh
+
+    telemetry.enable(True)
+    store = object.__new__(KVStorePartyMesh)
+    store.party_size = 4
+    KVStorePartyMesh.count_collective(store, 1000)
+    snap = telemetry.snapshot()
+    assert telemetry.mesh_bytes(snap) == 6000     # 2*(4-1)*1000
+    assert telemetry.wan_bytes(snap) == 0
+    msgs = [v for k, v in snap["counters"].items()
+            if k.startswith("mesh.messages{")]
+    assert msgs == [1]
+
+
 # ---------------------------------------------------------------------------
 # disabled-overhead microbench + live topology
 # ---------------------------------------------------------------------------

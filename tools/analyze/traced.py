@@ -16,6 +16,15 @@ GX-J103 (warning) train-step-shaped jitted function (name contains
                   ``step``/``update``, returns its own parameter state)
                   without ``donate_argnums`` — the old parameter buffers
                   stay live across the update, doubling peak memory.
+GX-J104 (error)   host transfer on a mesh rank's round path: round-shaped
+                  methods (name contains ``step``/``push``/``pull``/
+                  ``round``) of Mesh-named classes — closed over
+                  same-module calls — calling ``np.asarray``/``np.array``/
+                  ``jax.device_get``/``.addressable_data`` outside an
+                  ``is_global_worker`` guard. In the mesh-party tier
+                  (kvstore.mesh_party) only the party's global worker may
+                  materialize host arrays; an unguarded transfer makes
+                  EVERY mesh rank fetch device data it must never touch.
 
 Reachability: seeds are functions decorated with (or wrapped by a call
 to) ``jax.jit``/``jit``/``pjit``/``jax.shard_map``/``shard_map`` —
@@ -45,6 +54,8 @@ _HOST_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray",
 _HOST_SYNC_METHODS = (".item", ".tolist", ".numpy", ".block_until_ready")
 _SCALAR_CASTS = {"float", "int", "bool", "complex"}
 _STEP_NAME_RE = re.compile(r"step|update", re.IGNORECASE)
+_MESH_ROUND_RE = re.compile(r"step|push|pull|round", re.IGNORECASE)
+_HOST_XFER_METHODS = (".addressable_data",)
 
 
 def _jit_target(node: ast.Call) -> Tuple[Optional[ast.AST], bool]:
@@ -76,6 +87,57 @@ def _is_static_expr(node: ast.AST) -> bool:
         if isinstance(sub, ast.Call) and call_name(sub.func) == "len":
             return True
     return False
+
+
+def _mentions_global_worker(test: ast.AST) -> bool:
+    """True when the guard expression consults the global-worker flag
+    (``self.is_global_worker``, ``kv.is_global_worker``, a bare local,
+    or a ``getattr(..., "is_global_worker", ...)``)."""
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Attribute) and sub.attr == "is_global_worker":
+            return True
+        if isinstance(sub, ast.Name) and sub.id == "is_global_worker":
+            return True
+        if isinstance(sub, ast.Constant) and sub.value == "is_global_worker":
+            return True
+    return False
+
+
+def _scan_mesh_calls(node: ast.AST, hits: List[Tuple[ast.Call, str]]):
+    """Collect host-transfer calls under ``node``; an ``if`` whose test
+    consults is_global_worker suspends collection in its body (that
+    branch runs on the global worker only — its else branch does not)."""
+    if isinstance(node, ast.If) and _mentions_global_worker(node.test):
+        for c in node.orelse:
+            _scan_mesh_calls(c, hits)
+        return
+    if isinstance(node, ast.Call):
+        nm = call_name(node.func)
+        if nm in _HOST_SYNC_CALLS or nm.endswith(_HOST_XFER_METHODS):
+            hits.append((node, nm))
+    for child in ast.iter_child_nodes(node):
+        _scan_mesh_calls(child, hits)
+
+
+def _scan_mesh_body(stmts: Sequence[ast.stmt], guarded: bool,
+                    hits: List[Tuple[ast.Call, str]]):
+    """Scan a statement suite for unguarded host transfers. Two guard
+    shapes count: the transfer sits inside ``if ...is_global_worker...``,
+    or it follows an early-exit fence ``if not ...is_global_worker...:
+    return/raise`` in the same suite."""
+    g = guarded
+    for st in stmts:
+        if isinstance(st, ast.If) and _mentions_global_worker(st.test):
+            _scan_mesh_body(st.body, True, hits)
+            _scan_mesh_body(st.orelse, g, hits)
+            if (isinstance(st.test, ast.UnaryOp)
+                    and isinstance(st.test.op, ast.Not)
+                    and st.body
+                    and isinstance(st.body[-1], (ast.Return, ast.Raise))):
+                g = True
+            continue
+        if not g:
+            _scan_mesh_calls(st, hits)
 
 
 class _FnInfo:
@@ -279,4 +341,34 @@ def run_traced(sources: Sequence[SourceFile]) -> List[Finding]:
                          f"parameter state but donates nothing — pass "
                          f"donate_argnums for the state args so XLA can "
                          f"reuse the old buffers in place")))
+
+        # ---- GX-J104 host transfers on a mesh rank's round path ------
+        mesh_nodes: Set[ast.AST] = set()
+        mfrontier: List[ast.AST] = []
+        for fi in fns:
+            if fi.cls and "Mesh" in fi.cls \
+                    and _MESH_ROUND_RE.search(fi.node.name):
+                mesh_nodes.add(fi.node)
+                mfrontier.append(fi.node)
+        while mfrontier:
+            fn = mfrontier.pop()
+            fi = node_to_info[fn]
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call):
+                    callee = resolve(sub.func, fi)
+                    if callee is not None and callee.node not in mesh_nodes:
+                        mesh_nodes.add(callee.node)
+                        mfrontier.append(callee.node)
+        for fn in sorted(mesh_nodes, key=lambda n: n.lineno):
+            fi = node_to_info[fn]
+            hits: List[Tuple[ast.Call, str]] = []
+            _scan_mesh_body(list(fn.body), False, hits)
+            for call, nm in hits:
+                findings.append(Finding(
+                    "GX-J104", SEV_ERROR, src.rel, call.lineno,
+                    symbol=fi.qualname, detail=f"{nm}:{call.lineno}",
+                    message=(f"{nm}() on the mesh round path "
+                             f"{fi.qualname} materializes device data on "
+                             f"the host; only the party's global worker "
+                             f"may — guard with is_global_worker")))
     return findings
