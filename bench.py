@@ -905,6 +905,173 @@ def bench_transformer_bsc(threshold: float = 0.01, rounds: int = 30,
         topo.stop()
 
 
+# ---------------------------------------------------------------------------
+# Quantized combined wire (GEOMX_WIRE_CODEC): WAN bytes/round and
+# protocol round time per codec at the PERF.md 10-key CNN layout, plus a
+# cheap convergence-parity probe. Aggregator-mode PS throughout: the
+# store holds the round's aggregated gradient, so BOTH WAN directions
+# carry the codec — which is where the >= 4x byte drop comes from.
+# ---------------------------------------------------------------------------
+
+QUANT_WIRE_CODECS = ("", "fp16", "2bit", "mpq")
+QUANT_WIRE_ROUNDS = 40
+# final-loss gap gate for the 2-bit wire vs raw fp32 on the synthetic
+# regression (losses start at ~1.0; error feedback must close the gap)
+QUANT_PARITY_TOL = 0.05
+
+
+def _quant_wire_layout(policy: str, rounds: int):
+    """One measured config: dense combined rounds (push_pull_async, the
+    P3-chunked wire the codec rides) at the 10-key CNN layout, 2 parties
+    x 1 worker. Telemetry is reset after init so only training-round
+    bytes count. Returns (round_ms, wan_bytes/round, by_codec/round)."""
+    from geomx_tpu import telemetry
+    from geomx_tpu.simulate import InProcessHiPS
+    from tools.wire_bench import LAYOUTS
+
+    shapes = LAYOUTS["cnn"]
+    keys = list(range(len(shapes)))
+    topo = InProcessHiPS(
+        num_parties=2, workers_per_party=1,
+        extra_cfg={"wire_codec": policy,
+                   # only mpq reads it: head-sized CNN keys stay fp16,
+                   # the conv/fc bulk routes 2-bit
+                   "size_lower_bound": 2048}).start()
+    times = {}
+    try:
+        def master_init(kv):
+            for k, sh in zip(keys, shapes):
+                kv.init(k, np.zeros(sh, np.float32))
+            kv.wait()
+
+        def init_worker(kv):
+            for k, sh in zip(keys, shapes):
+                kv.init(k, np.zeros(sh, np.float32))
+            kv.wait()
+
+        topo.run_workers(init_worker, include_master=master_init,
+                         timeout=300)
+        telemetry.reset()
+        telemetry.enable(True)   # count the measured rounds only
+
+        def train(kv):
+            outs = [np.zeros(sh, np.float32) for sh in shapes]
+            grads = [np.ones(sh, np.float32) for sh in shapes]
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                fut = kv.push_pull_async(keys, grads, outs)
+                fut.wait(timeout=120)
+            times[id(kv)] = (time.perf_counter() - t0) / rounds * 1e3
+
+        topo.run_workers(train, timeout=600)
+        snap = telemetry.snapshot()
+    finally:
+        telemetry.reset()
+        topo.stop()
+    by_codec = {(c or "raw"): round(v / rounds, 1)
+                for c, v in telemetry.wan_bytes_by_codec(snap).items()}
+    return (max(times.values()),
+            telemetry.wan_bytes(snap) / rounds, by_codec)
+
+
+def _quant_wire_parity(policy: str, rounds: int = 200, d: int = 256,
+                       n_samples: int = 64, lr: float = 0.05):
+    """Convergence parity without the CNN's minutes-long bootstrap:
+    2-worker linear regression (distinct data shards), gradients summed
+    over the combined wire every round, SGD applied worker-side
+    (aggregator PS — both workers receive identical response bytes, so
+    replicas stay in sync by construction). Returns the mean final
+    local loss; with error feedback the 2-bit wire must land within
+    QUANT_PARITY_TOL of the raw-fp32 wire."""
+    from geomx_tpu.simulate import InProcessHiPS
+
+    # thr=0.1 ~ the gradient scale of this problem: each 2-bit firing
+    # carries a useful step, and EF-SGD's noise ball sits well inside
+    # the tolerance (thr much smaller accumulates residual bursts that
+    # destabilize the constant-lr tail)
+    topo = InProcessHiPS(
+        num_parties=2, workers_per_party=1,
+        extra_cfg={"wire_codec": policy,
+                   "wire_2bit_threshold": 0.1}).start()
+    losses = {}
+    try:
+        def master_init(kv):
+            kv.init(0, np.zeros(d, np.float32))
+            kv.wait()
+
+        def worker(kv):
+            widx = 0 if kv is topo.workers[0] else 1
+            w_true = (np.random.RandomState(7).randn(d)
+                      / np.sqrt(d)).astype(np.float32)
+            rng = np.random.RandomState(42 + widx)
+            X = rng.randn(n_samples, d).astype(np.float32)
+            y = X @ w_true
+            w = np.zeros(d, np.float32)
+            kv.init(0, w.copy())
+            kv.wait()
+            out = np.zeros(d, np.float32)
+            for _ in range(rounds):
+                r = X @ w - y
+                grad = (2.0 / n_samples) * (X.T @ r)
+                fut = kv.push_pull_async(0, grad, out)
+                fut.wait(timeout=120)
+                w -= lr * out / 2.0   # aggregate of 2 workers
+            r = X @ w - y
+            losses[widx] = float(np.mean(r * r))
+
+        topo.run_workers(worker, include_master=master_init,
+                         timeout=600)
+    finally:
+        topo.stop()
+    return (losses[0] + losses[1]) / 2.0
+
+
+def bench_quant_wire(rounds: int = QUANT_WIRE_ROUNDS):
+    """The quantized-wire capture: per-codec WAN bytes/round (broken out
+    by telemetry.wan_bytes_by_codec), protocol round time at the 10-key
+    layout, the >= 4x 2-bit reduction gate, and the loss-parity probe."""
+    codecs = {}
+    for policy in QUANT_WIRE_CODECS:
+        ms, wpr, by = _quant_wire_layout(policy, rounds)
+        codecs[policy or "raw"] = {
+            "round_ms": round(ms, 2),
+            "wan_bytes_per_round": round(wpr, 1),
+            "wan_bytes_by_codec": by}
+    reduction = (codecs["raw"]["wan_bytes_per_round"]
+                 / max(codecs["2bit"]["wan_bytes_per_round"], 1e-9))
+    fp32_loss = _quant_wire_parity("")
+    q_loss = _quant_wire_parity("2bit")
+    return {
+        "layout": "cnn", "keys": 10, "rounds": rounds,
+        "codecs": codecs,
+        "wan_reduction_2bit_vs_raw": round(reduction, 1),
+        "reduction_ok": bool(reduction >= 4.0),
+        "parity": {"fp32_loss": round(fp32_loss, 4),
+                   "2bit_loss": round(q_loss, 4),
+                   "delta": round(q_loss - fp32_loss, 4),
+                   "tol": QUANT_PARITY_TOL,
+                   "ok": bool(q_loss - fp32_loss <= QUANT_PARITY_TOL)},
+    }
+
+
+def bench_compress():
+    """Host (numpy) vs device (jax) pack throughput per wire codec
+    (tools/compress_bench.run_compress_bench): the fused device pack
+    must not lose to the host kernels it replaces. Device timings
+    include the D2H of the packed payload — bytes-ready-to-send."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import jax
+
+    from tools.compress_bench import run_compress_bench
+
+    sizes = [262144, 1048576]
+    return {"sizes": sizes, "backend": jax.default_backend(),
+            "threshold": 0.01,
+            "results": run_compress_bench(sizes)}
+
+
 def _device_alive(timeout_s: float = 180.0) -> bool:
     """Probe the accelerator IN A SUBPROCESS: a wedged tunnel hangs any
     in-process jax call forever, which would leave the driver with no
@@ -1001,6 +1168,8 @@ PHASES = {
     "hips_bsc": (bench_hips_bsc, 900, False),
     "hips_mesh": (bench_hips_mesh, 900, False),
     "hips_hfa": (bench_hips_hfa, 600, False),
+    "quant_wire": (bench_quant_wire, 900, False),
+    "compress": (bench_compress, 600, False),
     # MFU rows precede transformer_bsc: they are ~3-5 min each on a
     # healthy tunnel, while the 59M two-worker bootstrap can eat 10-20
     # min — under the driver's overall budget the cheap rows must not
@@ -1199,6 +1368,17 @@ def _assemble(data: dict):
                                    "trials": hfa["trials"]}
     else:
         details["hips_hfa_cnn"] = hfa or {"error": "not run"}
+    qw = data.get("quant_wire")
+    if ok(qw):
+        # the quantized-wire capture verbatim: per-codec WAN bytes and
+        # round ms, the >= 4x reduction gate, the loss-parity probe
+        details["quant_wire"] = {
+            k: qw[k] for k in ("layout", "keys", "rounds", "codecs",
+                               "wan_reduction_2bit_vs_raw",
+                               "reduction_ok", "parity") if k in qw}
+    else:
+        details["quant_wire"] = qw or {"error": "not run"}
+    details["compress"] = data.get("compress", {"error": "not run"})
     details["transformer_bsc_device"] = data.get(
         "transformer_bsc", {"error": "not run"})
     for key in _MFU_CONFIGS:

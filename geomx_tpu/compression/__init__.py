@@ -21,7 +21,8 @@ wire key (kvstore_dist_server.h:1479-1483); our messages carry explicit
 no placeholders are needed.
 
 Compression tags travel in ``Meta.compr`` / ``KVPairs.compr``:
-"" (none), "fp16", "bsc", "2bit".
+"" (none), "fp16", "bsc", "2bit" — plus "bsc16" (BSC with float16
+values) on the quantized combined wire (``compression.device``).
 """
 
 from __future__ import annotations
@@ -210,11 +211,13 @@ def _generic_decompress(tag, val, aux, orig_len):
                 ids, rows = ids[ok], rows[ok]
             np.add.at(out.reshape(n_rows, row_len), ids, rows)
         return out
-    if tag == "bsc":
+    if tag in ("bsc", "bsc16"):
         # scatter-ADD, not assignment: a push payload carrying duplicate
         # indices must aggregate by sum (same contract as the "rsp"
         # branch above); for pull payloads indices are unique (nonzeros
-        # of one array) so add and set coincide
+        # of one array) so add and set coincide. "bsc16" is the same
+        # wire with float16 values (quantized combined wire) — the
+        # astype below widens either way and aggregation stays fp32
         assert aux is not None, "bsc payload missing index aux array"
         idx = np.asarray(aux, dtype=np.int64).ravel()
         vals = np.asarray(val, dtype=np.float32).ravel()

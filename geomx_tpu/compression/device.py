@@ -1,0 +1,222 @@
+"""Quantized combined wire: device-fused per-chunk codecs.
+
+ROADMAP item 2 closed the *server* WAN hop with BSC/MPQ compressors;
+this module closes the *combined wire* (``KVStoreDist.push_pull_async``
+/ ``push_pull_bsc_batch_async``): every chunked message of a round can
+carry its payload as fp16 or residual-feedback 2-bit codes instead of
+raw fp32, with the codec chosen PER P3 CHUNK (the MPQ rule from the
+paper applied at chunk granularity — head/high-priority chunks keep
+fp16, bulk tail chunks drop to 2-bit). The pack runs as the jitted
+device kernels from :mod:`geomx_tpu.ops` whenever the gradient is still
+a device array, so D2H moves packed bytes, not fp32 (EQuARX's
+quantize-inside-the-step argument); host numpy kernels from
+:mod:`geomx_tpu.compression` serve processes without an accelerator and
+are bit-identical to the device path.
+
+Wire format (rides the existing ``Meta.compr`` tag, no schema change):
+
+- ``"fp16"`` — vals are float16, no aux;
+- ``"2bit"`` — vals are the packed uint8 codes (4/byte), aux is the
+  one-element float32 threshold; the original element count travels in
+  the existing per-entry ``lens`` meta;
+- ``"bsc16"`` — the BSC element-sparse wire with float16 values
+  (indices stay int32 aux, exactly like ``"bsc"``).
+
+Error-feedback residuals live HERE, per ``state_key`` — the callers key
+them per (key, shard offset) so P3 slicing, retries and round aborts
+never mix residual streams: an encode drains the residual exactly once
+per round (at message build time; chunk retries resend the already
+-packed bytes), and a round abort loses at most the one drained
+quantized step, bounded by the threshold.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["WIRE_POLICIES", "WireCodec", "decode_wire", "codec_requires_aux"]
+
+# accepted GEOMX_WIRE_CODEC values (Config.wire_codec):
+#   ""     — off (raw fp32, the round-5 wire)
+#   "fp16" — every chunk fp16
+#   "2bit" — every chunk 2-bit
+#   "mpq"  — per-chunk MPQ routing: chunks of >= size_lower_bound
+#            elements go 2-bit, smaller chunks fp16
+#   "p3"   — the P3-priority rule: the head chunk (highest priority,
+#            needed first on the next forward) stays fp16, tail chunks
+#            route like "mpq"
+WIRE_POLICIES = ("", "fp16", "2bit", "mpq", "p3")
+
+# wire tags whose payload is meaningless without the aux array
+# (threshold / indices); the GX-P307 static rule and the encode path
+# below enforce the pairing from both sides
+_AUX_REQUIRED = ("2bit", "rsp", "bsc16")
+
+
+def codec_requires_aux(tag: str) -> bool:
+    return tag in _AUX_REQUIRED
+
+
+def _submodule(name: str):
+    """Resolve ``geomx_tpu.<name>`` without the import system when it
+    is already loaded. Infra roles run their blocking role loop INSIDE
+    ``import geomx_tpu``, leaving the package permanently
+    mid-initialization on the main thread — an ``import geomx_tpu...``
+    statement from a van handler thread (encode/decode run there) would
+    block forever on the package's import lock. Every module the wire
+    codecs need is fully loaded before any wire byte moves, so plain
+    sys.modules access suffices; the importlib fallback only ever runs
+    in fully-imported (worker) processes."""
+    mod = sys.modules.get("geomx_tpu." + name)
+    if mod is not None:
+        return mod
+    import importlib
+
+    return importlib.import_module("geomx_tpu." + name)
+
+
+def _is_device_array(arr) -> bool:
+    """True for jax device arrays (anything ndarray-like that is not
+    numpy); used to pick the jitted pack so quantization happens before
+    D2H. Cheap duck-typing keeps jax an optional import."""
+    return not isinstance(arr, (np.ndarray, np.generic)) \
+        and hasattr(arr, "dtype") and hasattr(arr, "size")
+
+
+def decode_wire(tag: str, val, aux, orig_len: int) -> np.ndarray:
+    """Decode one wire entry back to a flat float32 host array.
+
+    Tag-driven like the server's push decompression (and sharing its
+    kernels), so worker response paths handle every codec the server
+    may echo: "" / "fp16" widen, "2bit" unpacks codes against the aux
+    threshold. Sparse tags ("bsc"/"bsc16"/"rsp") are NOT handled here —
+    their entries stay (values, indices) pairs at the call sites."""
+    if tag == "2bit":
+        compression = _submodule("compression")
+        thr = float(np.asarray(aux, np.float32).ravel()[0])
+        return compression.two_bit_dequantize(
+            np.asarray(val, np.uint8).ravel(), orig_len, thr)
+    return np.asarray(val).ravel().astype(np.float32)
+
+
+class WireCodec:
+    """Per-chunk codec policy + stateful encode/decode for one node.
+
+    One instance per store (worker side) or per server (WAN-forward and
+    response legs); residuals are keyed by caller-supplied ``state_key``
+    tuples so the four residual streams of a HiPS round (worker push,
+    party WAN forward, global response, party response) never mix.
+    """
+
+    def __init__(self, policy: str = "", threshold: float = 0.5,
+                 size_lower_bound: int = 200000):
+        if policy not in WIRE_POLICIES:
+            raise ValueError(
+                f"GEOMX_WIRE_CODEC={policy!r}: expected one of "
+                f"{WIRE_POLICIES}")
+        self.policy = policy
+        self.threshold = float(threshold)
+        self.size_lower_bound = int(size_lower_bound)
+        self._residual: Dict = {}
+        # encode runs on trainer AND transport threads (chunk sends,
+        # server handler threads); residual upserts need the lock
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_config(cls, cfg, policy: Optional[str] = None) -> "WireCodec":
+        return cls(cfg.wire_codec if policy is None else policy,
+                   threshold=cfg.wire_2bit_threshold,
+                   size_lower_bound=cfg.size_lower_bound)
+
+    def enabled(self) -> bool:
+        return self.policy != ""
+
+    # -- policy ----------------------------------------------------------
+
+    def chunk_codec(self, cid: int, num_chunks: int, num_elems: int) -> str:
+        """Codec for chunk ``cid`` of ``num_chunks`` holding
+        ``num_elems`` float32 elements (the ``codec_for`` callable shape
+        ``frontier.plan_chunks`` threads through)."""
+        p = self.policy
+        if p in ("", "fp16", "2bit"):
+            return p
+        if p == "p3" and cid == 0:
+            # the head chunk carries the layers the next forward needs
+            # first — keep it at fp16 accuracy (it is also the smallest)
+            return "fp16"
+        # "mpq" (and "p3" tails): the paper's size rule at chunk
+        # granularity — only bulk chunks amortize 2-bit's residual noise
+        return "2bit" if num_elems >= self.size_lower_bound else "fp16"
+
+    def resolve(self, num_elems: int) -> str:
+        """Codec for a standalone (un-chunked) tensor — the WAN-forward
+        leg routes per (key, slice) through this."""
+        return self.chunk_codec(1, 2, num_elems)
+
+    # -- encode/decode ---------------------------------------------------
+
+    def encode(self, tag: str, arr, state_key=None
+               ) -> Tuple[np.ndarray, Optional[np.ndarray], str]:
+        """Encode one wire entry; returns ``(wire_vals, aux, tag)`` as
+        host arrays ready for the van. 2-bit drains this state_key's
+        error-feedback residual exactly once — call at message BUILD
+        time only (retries must resend the built bytes)."""
+        if tag == "" or tag is None:
+            return np.asarray(arr, np.float32).ravel(), None, ""
+        if tag == "fp16":
+            if _is_device_array(arr):
+                # half-width cast on device: D2H moves 2 bytes/elem
+                arr = _jnp().asarray(arr).astype(_jnp().float16)
+                return np.asarray(arr).ravel(), None, "fp16"
+            return (np.asarray(arr, np.float32).ravel()
+                    .astype(np.float16), None, "fp16")
+        if tag == "2bit":
+            packed = self._encode_2bit(arr, state_key)
+            return packed, np.asarray([self.threshold], np.float32), "2bit"
+        raise ValueError(f"unknown wire codec {tag!r}")
+
+    def _encode_2bit(self, arr, state_key) -> np.ndarray:
+        if _is_device_array(arr):
+            ops = _submodule("ops")
+            jnp = _jnp()
+            with self._lock:
+                res = self._residual.get(state_key)
+                if res is None or not _is_device_array(res) \
+                        or res.size != arr.size:
+                    res = jnp.zeros(arr.size, jnp.float32)
+                packed, new_res = ops.two_bit_quantize(
+                    jnp.asarray(arr, jnp.float32).ravel(), res,
+                    self.threshold)
+                self._residual[state_key] = new_res
+            # the ONLY D2H of this entry: n/4 packed bytes
+            return np.asarray(packed, np.uint8)
+        compression = _submodule("compression")
+        a = np.asarray(arr, np.float32).ravel()
+        with self._lock:
+            res = self._residual.get(state_key)
+            if res is None or _is_device_array(res) or res.size != a.size:
+                res = self._residual[state_key] = np.zeros(a.size,
+                                                           np.float32)
+            return compression.two_bit_quantize(a, res, self.threshold)
+
+    def decode(self, tag: str, val, aux, orig_len: int) -> np.ndarray:
+        return decode_wire(tag, val, aux, orig_len)
+
+    def reset(self, state_key=None) -> None:
+        """Drop residual state (all keys, or one) — membership-epoch
+        recovery re-seeds from zero rather than replaying stale error."""
+        with self._lock:
+            if state_key is None:
+                self._residual.clear()
+            else:
+                self._residual.pop(state_key, None)
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp

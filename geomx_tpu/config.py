@@ -239,6 +239,23 @@ class Config:
     # a disjoint slice of this size
     party_mesh_size: int = 0            # GEOMX_PARTY_MESH_SIZE
 
+    # ---- quantized combined wire (ours; docs/env-var-summary.md
+    # "Quantized wire" + PERF.md "quantized wire") ----
+    # per-chunk wire codec for the async combined rounds
+    # (push_pull_async / push_pull_bsc_batch_async): "" = raw fp32 (off),
+    # "fp16", "2bit", "mpq" (chunk >= size_lower_bound elems -> 2bit,
+    # else fp16), "p3" (head chunk fp16, tail chunks mpq-routed). The
+    # server echoes the requester's codec on combined-wire responses and
+    # re-quantizes WAN forwards with it (2-bit error-feedback residuals
+    # per (key, offset) on both sides).
+    wire_codec: str = ""                # GEOMX_WIRE_CODEC
+    # per-tier override for the party server's WAN forward leg; "" =
+    # follow the codec the worker's push arrived with
+    wire_codec_wan: str = ""            # GEOMX_WIRE_CODEC_WAN
+    # threshold for the wire 2-bit codec (codes are {0, +thr, -thr};
+    # the un-sent remainder stays in the residual)
+    wire_2bit_threshold: float = 0.5    # GEOMX_WIRE_2BIT_THRESHOLD
+
     # ---- TPU-specific ----
     van_type: str = "auto"              # GEOMX_VAN in {auto, python, native}
     platform: str = ""                  # GEOMX_PLATFORM override for jax
@@ -336,6 +353,9 @@ def load() -> Config:
         overlap=env_bool("GEOMX_OVERLAP", True),
         party_mesh=env_bool("GEOMX_PARTY_MESH"),
         party_mesh_size=env_int("GEOMX_PARTY_MESH_SIZE", 0),
+        wire_codec=env_str("GEOMX_WIRE_CODEC"),
+        wire_codec_wan=env_str("GEOMX_WIRE_CODEC_WAN"),
+        wire_2bit_threshold=env_float("GEOMX_WIRE_2BIT_THRESHOLD", 0.5),
         van_type=env_str("GEOMX_VAN", "auto"),
         platform=env_str("GEOMX_PLATFORM"),
     )

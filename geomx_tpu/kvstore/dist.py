@@ -38,6 +38,7 @@ import numpy as np
 from geomx_tpu import config as cfg_mod
 from geomx_tpu import profiler
 from geomx_tpu import telemetry
+from geomx_tpu.compression.device import WireCodec, decode_wire
 from geomx_tpu.kvstore import sharding
 from geomx_tpu.kvstore.base import Command, DATA_INIT, KVStore, _sum_values
 from geomx_tpu.kvstore.frontier import RoundFuture, give_up_exc, plan_chunks
@@ -55,6 +56,25 @@ def _give_up_exc(errs) -> type:
     dead" raises WorkerLostError, a blown PS_RESEND_DEADLINE is a
     TimeoutError, retry-cap give-ups stay RuntimeError."""
     return give_up_exc(errs)
+
+
+def _wire_decode(kvs, i: int) -> np.ndarray:
+    """Decode dense response entry ``i`` of ``kvs`` to flat float32:
+    the combined-wire server echoes the requester's codec on its acks
+    ("" / "fp16" / "2bit" — compression.device), so every dense
+    response path funnels through the tag-driven decode instead of a
+    raw astype. The original element count rides the entry's ``lens``
+    meta (the 2-bit pack is 4 codes/byte)."""
+    aux = kvs.aux[i] if i < len(kvs.aux) else None
+    return decode_wire(kvs.compr, kvs.vals[i], aux, kvs.len_of(i) or 0)
+
+
+def _is_device_array(arr) -> bool:
+    """jax device array duck-check (mirrors compression.device): lets
+    the combined wire keep gradients on device until the per-chunk
+    encode so D2H moves packed bytes."""
+    return not isinstance(arr, (np.ndarray, np.generic)) \
+        and hasattr(arr, "dtype") and hasattr(arr, "size")
 
 
 class _KeyInfo:
@@ -117,6 +137,10 @@ class KVStoreDist(KVStore):
         # id carried in Meta.trace_round on each of its wire messages;
         # notify_round() re-syncs it to the trainer's numbering
         self._round_seq = 0
+        # quantized combined wire (GEOMX_WIRE_CODEC; compression.device):
+        # per-chunk codecs for push_pull_async / push_pull_bsc_batch_async
+        # with 2-bit error-feedback residuals keyed per (key, offset)
+        self._wire = WireCodec.from_config(c)
 
         # startup barrier (reference: kvstore_dist.h:64), then the
         # creation-time command protocol (reference: kvstore.cc:56-63).
@@ -503,8 +527,7 @@ class KVStoreDist(KVStore):
             finished = []
             for kvs in self.kvw.take_response(ts):
                 for i, k in enumerate(kvs.keys):
-                    data = np.asarray(kvs.vals[i]).ravel().astype(
-                        np.float32)
+                    data = _wire_decode(kvs, i)
                     r_off = kvs.offset_of(i)
                     buf = bufs[k]
                     n = min(data.size, buf.size - r_off)
@@ -598,18 +621,27 @@ class KVStoreDist(KVStore):
                 raise TypeError(
                     "push_pull_async requires writable numpy ndarrays")
         sb = self.cfg.p3_slice_bytes if slice_bytes is None else slice_bytes
+        wire_on = self._wire.enabled()
         # layer-ordered (key, shard, flat-segment) entry list
         entries = []
         for k, v in zip(keys, values):
             merged = _sum_values(v)
             info = self._info(k, merged)
-            flat = np.ascontiguousarray(merged).ravel()
+            if wire_on and _is_device_array(merged):
+                # quantized wire + device gradient: stay on device —
+                # the per-chunk encode below packs there, so the D2H
+                # is the packed bytes, not fp32
+                flat = merged.ravel()
+            else:
+                flat = np.ascontiguousarray(merged).ravel()
             for sh in info.shards:
                 entries.append(
                     (k, sh, flat[sh.offset:sh.offset + sh.length]))
-        chunks = plan_chunks(list(range(len(entries))),
-                             [e[2].nbytes for e in entries],
-                             sb, base_priority=priority)
+        chunks = plan_chunks(
+            list(range(len(entries))),
+            [int(e[2].size) * 4 for e in entries],
+            sb, base_priority=priority,
+            codec_for=self._wire.chunk_codec if wire_on else None)
         rid = self._begin_round()
         fut = RoundFuture(keys, consume=self._consume_errors,
                           max_retries=self.cfg.chunk_retries,
@@ -626,9 +658,21 @@ class KVStoreDist(KVStore):
             server_keys: Dict[int, List[int]] = {}
             for ei in ch.items:
                 k, sh, seg = entries[ei]
-                kvs = per_server.setdefault(sh.server_rank, KVPairs())
+                kvs = per_server.setdefault(
+                    sh.server_rank, KVPairs(compr=ch.codec))
                 kvs.keys.append(k)
-                kvs.vals.append(seg)
+                if ch.codec:
+                    # encode ONCE at message build: chunk retries below
+                    # resend these bytes, so the 2-bit residual for
+                    # (key, offset) drains exactly once per round
+                    wv, aux, _tag = self._wire.encode(
+                        ch.codec, seg, (k, sh.offset))
+                    kvs.vals.append(wv)
+                    # always append (None for fp16): the server's push
+                    # decompress indexes aux[i] positionally
+                    kvs.aux.append(aux)
+                else:
+                    kvs.vals.append(np.asarray(seg))
                 kvs.offsets.append(sh.offset)
                 kvs.totals.append(sh.total)
                 kvs.lens.append(sh.length)
@@ -684,8 +728,7 @@ class KVStoreDist(KVStore):
             with profiler.chunk_scope("recv", cid, server=srank):
                 for kvs in self.kvw.take_response(ts):
                     for i, k in enumerate(kvs.keys):
-                        data = np.asarray(kvs.vals[i]).ravel().astype(
-                            np.float32)
+                        data = _wire_decode(kvs, i)
                         r_off = kvs.offset_of(i)
                         buf = bufs[k]
                         n = min(data.size, buf.size - r_off)
@@ -820,8 +863,7 @@ class KVStoreDist(KVStore):
             finished = []
             for kvs in self.kvw.take_response(ts):
                 for i, k in enumerate(kvs.keys):
-                    data = np.asarray(kvs.vals[i]).ravel().astype(
-                        np.float32)
+                    data = _wire_decode(kvs, i)
                     r_off = kvs.offset_of(i)
                     buf = bufs[k]
                     n = min(data.size, buf.size - r_off)
@@ -897,7 +939,7 @@ class KVStoreDist(KVStore):
             resps = self.kvw.take_response(ts)
             for kvs in resps:
                 for i, _k in enumerate(kvs.keys):
-                    data = np.asarray(kvs.vals[i]).ravel().astype(np.float32)
+                    data = _wire_decode(kvs, i)
                     r_off = kvs.offset_of(i)
                     n = min(data.size, info.total - r_off)
                     buf[r_off:r_off + n] = data[:n]
@@ -1119,7 +1161,7 @@ class KVStoreDist(KVStore):
                                       dtype=np.float32).ravel()
                     r_off = kvs.offset_of(i)
                     aux = kvs.aux[i] if i < len(kvs.aux) else None
-                    if kvs.compr == "bsc" and aux is not None:
+                    if kvs.compr in ("bsc", "bsc16") and aux is not None:
                         gidx = (np.asarray(aux, np.int64).ravel() + r_off)
                         with self._lock:
                             parts.append((data, gidx))
@@ -1169,10 +1211,14 @@ class KVStoreDist(KVStore):
 
         return join
 
-    def _prepare_bsc_shards(self, keys, values_list, indices_list):
+    def _prepare_bsc_shards(self, keys, values_list, indices_list,
+                            wire_tag: str = "bsc"):
         """Validate per-key sparse selections and partition them into
         one KVPairs per server (shared by the separate and combined BSC
-        wire sends)."""
+        wire sends). ``wire_tag="bsc16"`` ships the selected values as
+        float16 (the quantized combined wire; indices stay int32) — the
+        trainer's device-side error feedback makes the narrowing
+        lossless on the wire (trainer_device.select)."""
         per_server: Dict[int, KVPairs] = {}
         server_keys: Dict[int, List[int]] = {}
         prepared = []
@@ -1190,9 +1236,10 @@ class KVStoreDist(KVStore):
             for sh in info.shards:
                 sel = (idx >= sh.offset) & (idx < sh.offset + sh.length)
                 kvs = per_server.setdefault(sh.server_rank,
-                                            KVPairs(compr="bsc"))
+                                            KVPairs(compr=wire_tag))
                 kvs.keys.append(k)
-                kvs.vals.append(vals[sel])
+                kvs.vals.append(vals[sel].astype(np.float16)
+                                if wire_tag == "bsc16" else vals[sel])
                 kvs.aux.append((idx[sel] - sh.offset).astype(np.int32))
                 kvs.offsets.append(sh.offset)
                 kvs.totals.append(sh.total)
@@ -1214,7 +1261,8 @@ class KVStoreDist(KVStore):
                 self.push_bsc(k, v, ix, priority=priority - i)
             return
         per_server, server_keys = self._prepare_bsc_shards(
-            keys, values_list, indices_list)
+            keys, values_list, indices_list,
+            wire_tag="bsc16" if self._wire.enabled() else "bsc")
         self._send_batch_pushes(per_server, server_keys, priority)
 
     def push_pull_bsc_batch(self, keys, values_list, indices_list,
@@ -1233,7 +1281,8 @@ class KVStoreDist(KVStore):
             return self.pull_bsc_batch(keys, priority=priority,
                                        timeout=timeout)
         per_server, server_keys = self._prepare_bsc_shards(
-            keys, values_list, indices_list)
+            keys, values_list, indices_list,
+            wire_tag="bsc16" if self._wire.enabled() else "bsc")
         rid = self._begin_round()
         parts: Dict[int, List] = {k: [] for k in keys}
         fails: List[str] = []
@@ -1262,7 +1311,7 @@ class KVStoreDist(KVStore):
                                       dtype=np.float32).ravel()
                     r_off = kvs.offset_of(i)
                     aux = kvs.aux[i] if i < len(kvs.aux) else None
-                    if kvs.compr == "bsc" and aux is not None:
+                    if kvs.compr in ("bsc", "bsc16") and aux is not None:
                         entry = (data,
                                  np.asarray(aux, np.int64).ravel()
                                  + r_off)
@@ -1348,8 +1397,10 @@ class KVStoreDist(KVStore):
         keys = list(keys)
         sb = self.cfg.p3_slice_bytes if slice_bytes is None else slice_bytes
         sizes = [np.asarray(v).size * 8 for v in values_list]
-        chunks = plan_chunks(list(range(len(keys))), sizes, sb,
-                             base_priority=priority)
+        chunks = plan_chunks(
+            list(range(len(keys))), sizes, sb, base_priority=priority,
+            codec_for=(self._wire.chunk_codec if self._wire.enabled()
+                       else None))
         rid = self._begin_round()
         fut = RoundFuture(keys, consume=self._consume_errors,
                           max_retries=self.cfg.chunk_retries,
@@ -1360,9 +1411,13 @@ class KVStoreDist(KVStore):
         key_msgs: Dict[int, List[int]] = {k: [] for k in keys}
         for ch in chunks:
             cks = [keys[i] for i in ch.items]
+            # sparse chunks have exactly two widths: raw fp32 values
+            # ("bsc") or fp16 values ("bsc16") — any active wire codec
+            # maps to the narrow one (indices dominate past that)
             per_server, server_keys = self._prepare_bsc_shards(
                 cks, [values_list[i] for i in ch.items],
-                [indices_list[i] for i in ch.items])
+                [indices_list[i] for i in ch.items],
+                wire_tag="bsc16" if ch.codec else "bsc")
             for srank, kvs in per_server.items():
                 mid = len(msgs)
                 for k in set(server_keys[srank]):
@@ -1415,7 +1470,7 @@ class KVStoreDist(KVStore):
                                           dtype=np.float32).ravel()
                         r_off = kvs.offset_of(i)
                         aux = kvs.aux[i] if i < len(kvs.aux) else None
-                        if kvs.compr == "bsc" and aux is not None:
+                        if kvs.compr in ("bsc", "bsc16") and aux is not None:
                             entry = (data,
                                      np.asarray(aux, np.int64).ravel()
                                      + r_off)
@@ -1518,7 +1573,7 @@ class KVStoreDist(KVStore):
                                       dtype=np.float32).ravel()
                     r_off = kvs.offset_of(i)
                     aux = kvs.aux[i] if i < len(kvs.aux) else None
-                    if kvs.compr == "bsc" and aux is not None:
+                    if kvs.compr in ("bsc", "bsc16") and aux is not None:
                         entry = (data,
                                  np.asarray(aux, np.int64).ravel()
                                  + r_off)
@@ -1611,7 +1666,7 @@ class KVStoreDist(KVStore):
                                       dtype=np.float32).ravel()
                     r_off = kvs.offset_of(i)
                     aux = kvs.aux[i] if i < len(kvs.aux) else None
-                    if kvs.compr == "bsc" and aux is not None:
+                    if kvs.compr in ("bsc", "bsc16") and aux is not None:
                         entry = (data,
                                  np.asarray(aux, np.int64).ravel()
                                  + r_off)

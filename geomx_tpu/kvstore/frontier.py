@@ -73,46 +73,67 @@ class Chunk:
     """One priority-ordered slice of a round: ``items`` is a subset of
     the caller's entries (keys, or (key, shard) indices) in layer
     order; ``priority`` already encodes the P3 rule (chunk i of a
-    round at base priority p sends at p - i)."""
+    round at base priority p sends at p - i); ``codec`` is the wire
+    codec every message of this chunk travels with ("" = raw fp32 —
+    see compression.device.WireCodec)."""
 
-    __slots__ = ("cid", "items", "priority")
+    __slots__ = ("cid", "items", "priority", "codec")
 
-    def __init__(self, cid: int, items: List, priority: int):
+    def __init__(self, cid: int, items: List, priority: int,
+                 codec: str = ""):
         self.cid = cid
         self.items = items
         self.priority = priority
+        self.codec = codec
 
     def __repr__(self) -> str:  # debugging/test aid
         return f"Chunk(cid={self.cid}, items={self.items}, " \
-               f"priority={self.priority})"
+               f"priority={self.priority}, codec={self.codec!r})"
 
 
 def plan_chunks(items: Sequence, sizes_bytes: Sequence[int],
-                budget_bytes: int, base_priority: int = 0) -> List[Chunk]:
+                budget_bytes: int, base_priority: int = 0,
+                codec_for: Optional[Callable[[int, int, int], str]] = None,
+                ) -> List[Chunk]:
     """Greedily group ``items`` (layer order preserved) into chunks of
     at most ~``budget_bytes`` each; an item larger than the budget gets
     a chunk of its own rather than being split (splitting is the
     caller's job — dense keys split at ``_shards`` granularity, BSC
     keys must stay whole because the server FSA counts one push per
     (key, shard) per worker per round). ``budget_bytes <= 0`` means one
-    chunk holding everything (the round-5 batched wire)."""
+    chunk holding everything (the round-5 batched wire).
+
+    ``codec_for(cid, num_chunks, num_elems)`` — typically
+    ``WireCodec.chunk_codec`` — stamps each chunk's wire codec after
+    grouping, with ``num_elems`` the chunk's float32 element count, so
+    P3 priority picks the width (head chunks fp16, bulk tails 2-bit)."""
     assert len(items) == len(sizes_bytes)
     if not items:
         return []
     if budget_bytes <= 0:
-        return [Chunk(0, list(items), base_priority)]
+        chunks = [Chunk(0, list(items), base_priority)]
+        total = sum(sizes_bytes)
+        if codec_for is not None:
+            chunks[0].codec = codec_for(0, 1, total // 4)
+        return chunks
     chunks: List[Chunk] = []
+    chunk_bytes: List[int] = []
     cur: List = []
     cur_bytes = 0
     for it, sz in zip(items, sizes_bytes):
         if cur and cur_bytes + sz > budget_bytes:
             chunks.append(Chunk(len(chunks), cur,
                                 base_priority - len(chunks)))
+            chunk_bytes.append(cur_bytes)
             cur, cur_bytes = [], 0
         cur.append(it)
         cur_bytes += sz
     if cur:
         chunks.append(Chunk(len(chunks), cur, base_priority - len(chunks)))
+        chunk_bytes.append(cur_bytes)
+    if codec_for is not None:
+        for ch, nbytes in zip(chunks, chunk_bytes):
+            ch.codec = codec_for(ch.cid, len(chunks), nbytes // 4)
     return chunks
 
 

@@ -16,8 +16,11 @@ Topology (docs/mesh-party.md):
   ``jax.process_index() == 0`` — speaks the existing van to the party
   server (which keeps its raw-KVWorker forwarding role to the global
   tier), reusing :class:`KVStoreDist`'s combined wire, P3 slicing, BSC,
-  membership epochs and trace stamping unchanged. The party cfg says
-  ``num_workers=1``: the van sees one worker per party;
+  quantized wire codecs (``GEOMX_WIRE_CODEC`` — the inner store's
+  :class:`compression.device.WireCodec` and its error-feedback
+  residuals live on this one van-speaking rank), membership epochs and
+  trace stamping unchanged. The party cfg says ``num_workers=1``: the
+  van sees one worker per party;
 - results are broadcast back into the mesh as replicated device arrays
   (``device_put`` with a replicated NamedSharding); BSC top-k selection
   and residual feedback compute device-side (trainer_device) so only

@@ -64,6 +64,7 @@ from geomx_tpu import kernels_native
 from geomx_tpu import profiler
 from geomx_tpu import telemetry
 from geomx_tpu.compression import make_compressor
+from geomx_tpu.compression.device import WireCodec
 from geomx_tpu.kvstore import sharding
 from geomx_tpu.kvstore.base import Command, DATA_INIT
 from geomx_tpu.ps import base as psbase
@@ -170,6 +171,7 @@ class _KeyState:
         "offset", "length", "total", "dtype", "elems_received", "init_elems",
         "fwd_parts", "fwd_expected", "fwd_acks_left", "version", "cycle",
         "fwd_wire", "pre_init_pushes", "central_pushes", "master",
+        "push_compr", "rsp_wire",
     )
 
     def __init__(self, offset: int):
@@ -227,6 +229,16 @@ class _KeyState:
         self.central_pushes = 0
         # gradient pushes that raced ahead of initialization (replayed)
         self.pre_init_pushes: List = []
+        # wire codec the last gradient round's pushes arrived with
+        # (quantized combined wire): the WAN forward inherits it when no
+        # explicit GEOMX_WIRE_CODEC_WAN override is configured
+        self.push_compr = ""
+        # (lo, hi, tag) -> (version, wire_vals, aux): per-round response
+        # encode cache. Every puller of one round must receive IDENTICAL
+        # wire bytes, and a stateful codec (2bit error feedback) must
+        # drain its residual exactly once per round — the version stamp
+        # invalidates the cache when the store advances
+        self.rsp_wire: Dict = {}
 
 
 class KVStoreDistServer:
@@ -271,6 +283,15 @@ class KVStoreDistServer:
         self._stops_received = 0
         self.updater = None            # optimizer; applied on the global store
         self.gc = make_compressor(None)
+        # quantized combined wire (compression/device.py): one encode
+        # engine holds this server's error-feedback residuals — WAN
+        # forwards key them ("fwd", key, lo), response legs ("rsp", key,
+        # lo), so the two streams never mix. The optional WAN-only
+        # policy override picks the forward codec independently of what
+        # the workers pushed with.
+        self._wire = WireCodec.from_config(c)
+        self._wire_wan = (WireCodec.from_config(c, policy=c.wire_codec_wan)
+                          if c.wire_codec_wan else None)
         # fp32 master-weight updates for fp16-stored keys (reference:
         # kSetMultiPrecision, kvstore_dist_server.h:324)
         self.multi_precision = False
@@ -629,7 +650,8 @@ class KVStoreDistServer:
                 st = self._state(key, off)
                 with st.lock:
                     acts += self._push_local_store(req, srv, key, off,
-                                                   val, total)
+                                                   val, total,
+                                                   wire_compr=kvs.compr)
         elif req.pull:
             length = kvs.len_of(i)
             aux = kvs.aux[i] if i < len(kvs.aux) else None
@@ -647,8 +669,16 @@ class KVStoreDistServer:
     # party (intra-DC) server: push (reference: DataHandleSyncDefault)
     # ------------------------------------------------------------------
 
-    def _push_local_store(self, req, srv, key, off, val, total) -> List[Action]:
+    def _push_local_store(self, req, srv, key, off, val, total,
+                          wire_compr: str = "") -> List[Action]:
         st = self._state(key, off)
+        if req.head != DATA_INIT:
+            # remember the wire codec this round's gradients travel with
+            # (all pushes of one (key, shard) round share the chunk's
+            # codec); the WAN forward inherits it when no explicit
+            # GEOMX_WIRE_CODEC_WAN policy overrides
+            st.push_compr = wire_compr \
+                if wire_compr in ("fp16", "2bit", "bsc16") else ""
         if st.stored is None:
             # init-on-first-push (reference: kvstore_dist_server.h:1241);
             # kv.init marks its pushes DATA_INIT — a gradient should never
@@ -818,7 +848,7 @@ class KVStoreDistServer:
             if req.pull:
                 acts = [self._pull_response_action(
                     st, req, srv, key, lo, sub.size,
-                    self.gc.pull_compr_tag(sub.size))]
+                    self._ack_tag(req, sub.size, wan=True))]
             else:
                 acts = [lambda: srv.response(req)]
             if self.ts_local is not None:
@@ -843,7 +873,7 @@ class KVStoreDistServer:
                 # forward wire; round-4 verdict item 5)
                 acts = [self._pull_response_action(
                     st, req, srv, key, lo, sub.size,
-                    self.gc.pull_compr_tag(sub.size))]
+                    self._ack_tag(req, sub.size, wan=True))]
             else:
                 acts = [lambda: srv.response(req)]
             if self.ts_local is not None:
@@ -944,7 +974,7 @@ class KVStoreDistServer:
                 # pushed slice in the ack (see MixedSync branch)
                 acts.append(self._pull_response_action(
                     st, r, s, key, t[2], t[3] - t[2],
-                    self.gc.pull_compr_tag(t[3] - t[2])))
+                    self._ack_tag(r, t[3] - t[2], wan=True)))
             else:
                 acts.append(lambda r=r, s=s: s.response(r))
         acts += self._flush_pulls(st, key)
@@ -1086,6 +1116,34 @@ class KVStoreDistServer:
                               totals=[st.total], lens=[hi - lo],
                               compr="bsc")
                 return lambda: srv.response(req, out)
+        if req_compr == "bsc16":
+            # quantized combined wire: the "bsc" exact-nonzeros response
+            # with float16 values. Same dense-downgrade rule: an updater
+            # means the store holds dense weights, where the non-zero
+            # filter truncates — serve dense fp16 instead (still narrow)
+            if self.updater is not None:
+                req_compr = "fp16"
+            else:
+                nz = np.nonzero(data)[0]
+                out = KVPairs(keys=[key],
+                              vals=[data[nz].astype(np.float16)],
+                              aux=[nz.astype(np.int32)], offsets=[lo],
+                              totals=[st.total], lens=[hi - lo],
+                              compr="bsc16")
+                return lambda: srv.response(req, out)
+        if req_compr == "2bit":
+            # threshold codes carry GRADIENT sign/magnitude with error
+            # feedback; against an updater's dense weights they would
+            # replace every parameter with +-threshold — downgrade to
+            # the half-width cast (mirrors the BSC dense-downgrade)
+            if self.updater is not None:
+                req_compr = "fp16"
+            else:
+                payload, thr_aux = self._rsp_wire(st, key, lo, hi, "2bit")
+                out = KVPairs(keys=[key], vals=[payload], aux=[thr_aux],
+                              offsets=[lo], totals=[st.total],
+                              lens=[hi - lo], compr="2bit")
+                return lambda: srv.response(req, out)
         if req_compr:
             # pull-side compression on the WAN hop (reference:
             # DefaultStorageResponse BSC branch, :1190-1210)
@@ -1134,6 +1192,36 @@ class KVStoreDistServer:
         return max(self.po_global.num_live_workers()
                    if self.po_global else 1, 1)
 
+    def _rsp_wire(self, st: _KeyState, key: int, lo: int, hi: int,
+                  tag: str):
+        """Encode (and cache) one response range with a stateful wire
+        codec. Runs under ``st.lock`` (every _pull_response_action call
+        site holds it): all pullers of one round get IDENTICAL bytes and
+        the ("rsp", key, lo) error-feedback residual drains exactly once
+        per store version."""
+        ck = (lo, hi, tag)
+        cached = st.rsp_wire.get(ck)
+        if cached is None or cached[0] != st.version:
+            wv, aux, _t = self._wire.encode(
+                tag, st.stored[lo - st.offset:hi - st.offset],
+                ("rsp", key, lo))
+            cached = st.rsp_wire[ck] = (st.version, wv, aux)
+        return cached[1], cached[2]
+
+    def _ack_tag(self, r: ReqMeta, n: int, wan: bool = False) -> str:
+        """Wire tag for a combined push+pull ack: echo the requester's
+        codec — the quantized combined wire narrows BOTH directions —
+        downgraded when an updater means the response carries dense
+        WEIGHTS (threshold codes destroy them, sparse filters truncate).
+        Falls back to the configured compressor's pull tag on the WAN
+        tier and to raw on the LAN tier (its pre-wire behavior)."""
+        c = r.compr
+        if c in ("fp16", "2bit", "bsc", "bsc16"):
+            if self.updater is not None:
+                return "" if c == "bsc" else "fp16"
+            return c
+        return self.gc.pull_compr_tag(n) if wan else ""
+
     def _push_round_acks(self, st: _KeyState, key: int,
                          reqs) -> List[Action]:
         """Ack a completed local round's pushes. A combined push+pull
@@ -1145,10 +1233,9 @@ class KVStoreDistServer:
         for t in self._uniq(reqs):
             r, s = t[0], t[1]
             if r.pull:
-                tag = "bsc" if r.compr == "bsc" and self.updater is None \
-                    else ""
                 acts.append(self._pull_response_action(
-                    st, r, s, key, st.offset, 0, tag))
+                    st, r, s, key, st.offset, 0,
+                    self._ack_tag(r, st.length)))
             else:
                 acts.append(lambda r=r, s=s: s.response(r))
         return acts
@@ -1163,7 +1250,7 @@ class KVStoreDistServer:
             # _pull_response_action when an updater holds dense weights)
             acts.append(self._pull_response_action(
                 st, req, srv, key, off, length,
-                compr if compr in ("rsp", "bsc") else "", aux))
+                compr if compr in ("rsp", "bsc", "bsc16") else "", aux))
         return acts
 
     # ------------------------------------------------------------------
@@ -1171,6 +1258,46 @@ class KVStoreDistServer:
     # (reference: DataPushToGlobalServers* :745-830, push-ack counting
     #  :936-950, pull-back assembly :952-1167)
     # ------------------------------------------------------------------
+
+    def _wan_wire_tag(self, st: _KeyState, n: int) -> str:
+        """Wire codec for one forwarded slice of ``n`` elements: an
+        explicit GEOMX_WIRE_CODEC_WAN policy wins, else the forward
+        inherits the codec the workers pushed this round with, else the
+        party's own GEOMX_WIRE_CODEC routes by size. "" = leave the
+        hop to the configured gradient compressor."""
+        if self._wire_wan is not None:
+            return self._wire_wan.resolve(n)
+        if st.push_compr:
+            return st.push_compr
+        if self._wire.enabled():
+            return self._wire.resolve(n)
+        return ""
+
+    def _wan_compress(self, st: _KeyState, key: int, lo: int,
+                      sub: np.ndarray):
+        """Compress one WAN-forward slice -> (wire_val, aux, compr).
+
+        The configured compressor still runs first so BSC momentum /
+        selection state advances exactly as before; an active wire
+        codec then narrows a sparse payload's values to fp16 ("bsc16")
+        or, when the compressor was a no-op, packs the slice itself
+        (fp16 / 2bit with the ("fwd", key, lo) residual). Callers cache
+        the result in ``st.fwd_wire`` — a WAN retry must resend the
+        SAME bytes, never re-encode."""
+        tag = self._wan_wire_tag(st, int(sub.size))
+        if not tag:
+            return self.gc.compress_push(sub, (key, lo))
+        wv, aux, t = self.gc.compress_push(sub, (key, lo))
+        if t == "bsc":
+            # keep the selection (its momentum/residual state already
+            # advanced); only the values narrow on the wire
+            return np.asarray(wv, np.float16), aux, "bsc16"
+        if t:
+            return wv, aux, t
+        if tag in ("bsc", "bsc16"):
+            # no sparse selection available for this slice: dense fp16
+            tag = "fp16"
+        return self._wire.encode(tag, sub, ("fwd", key, lo))
 
     def _wan_trace_kwargs(self) -> Dict[str, int]:
         """Trace context for WAN re-issues of the current round — the
@@ -1209,7 +1336,7 @@ class KVStoreDistServer:
             cached = st.fwd_wire.get(lo)
             if cached is None:
                 sub = np.ascontiguousarray(st.outbound[lo - off:hi - off])
-                cached = self.gc.compress_push(sub, (key, lo))
+                cached = self._wan_compress(st, key, lo, sub)
                 st.fwd_wire[lo] = cached
         wire_val, aux, compr = cached
         kvs = KVPairs(keys=[key], vals=[wire_val], aux=[aux],
@@ -1253,7 +1380,7 @@ class KVStoreDistServer:
                 total = st.total
                 for g_rank, lo, hi in slices:
                     sub = np.ascontiguousarray(st.outbound[lo - off:hi - off])
-                    cached = self.gc.compress_push(sub, (key, lo))
+                    cached = self._wan_compress(st, key, lo, sub)
                     st.fwd_wire[lo] = cached
                     wire_val, aux, compr = cached
                     per_rank.setdefault((g_rank, compr), []).append(
@@ -1482,7 +1609,8 @@ class KVStoreDistServer:
             # peer-to-peer relay hops and the model dissemination travel
             # uncompressed (the reference TSEngine predates compression
             # composition and does the same)
-            wire_val, aux, compr = self.gc.compress_push(sub, (key, lo))
+            wire_val, aux, compr = self._wan_compress(
+                self._state(key, off), key, lo, sub)
             kvs = KVPairs(keys=[key], vals=[wire_val], aux=[aux],
                           offsets=[lo], totals=[total], lens=[hi - lo],
                           compr=compr)

@@ -209,6 +209,31 @@ def wan_bytes(snap: Optional[Dict[str, Any]] = None) -> float:
     return total
 
 
+def wan_bytes_by_codec(snap: Optional[Dict[str, Any]] = None
+                       ) -> Dict[str, float]:
+    """WAN send bytes broken out per wire codec: parses the ``codec=``
+    label out of the same ``van.bytes_sent{...tier=global...}`` counters
+    :func:`wan_bytes` sums, so the two always agree. Keys are the wire
+    tags ("raw", "fp16", "2bit", "bsc", "bsc16", ...) — the quantized
+    combined wire's >=4x drop shows up as raw/fp32 bytes moving into
+    the narrow-codec buckets."""
+    if snap is None:
+        snap = snapshot()
+    out: Dict[str, float] = {}
+    for key, v in snap.get("counters", {}).items():
+        if not (key.startswith("van.bytes_sent{")
+                and "tier=global" in key):
+            continue
+        codec = "raw"
+        inner = key[key.index("{") + 1:key.rindex("}")]
+        for part in inner.split(","):
+            if part.startswith("codec="):
+                codec = part[len("codec="):]
+                break
+        out[codec] = out.get(codec, 0.0) + v
+    return out
+
+
 def mesh_bytes(snap: Optional[Dict[str, Any]] = None) -> float:
     """Total bytes moved by mesh-party device collectives in ``snap``
     (default: the live registry). These live under their own counter

@@ -147,6 +147,15 @@ class DeviceResidentTrainer:
         self._up_cap = m = nw * self.k
         K = self.k
 
+        # quantized combined wire: when a wire codec is active the store
+        # ships the selected values as float16 ("bsc16"). Fuse the
+        # narrowing into the device step with error feedback — the fp16
+        # rounding error goes BACK into the residual v instead of being
+        # dropped on the host cast, so the wire's astype(float16) in
+        # dist._prepare_bsc_shards is exactly lossless
+        wire16 = bool(getattr(getattr(self.kv, "cfg", None),
+                              "wire_codec", ""))
+
         def select(flat, u, v, X, y):
             lv = [p.reshape(s) for p, s in
                   zip(jnp.split(flat, bounds), shapes)]
@@ -164,8 +173,16 @@ class DeviceResidentTrainer:
                 idx_parts.append((ii + off).astype(jnp.int32))
             vals = jnp.concatenate(vals_parts)
             idx = jnp.concatenate(idx_parts)       # model-flat positions
-            v = v.at[idx].set(0.0)
             u = u.at[idx].set(0.0)
+            if wire16:
+                narrowed = vals.astype(jnp.float16).astype(jnp.float32)
+                # selected coordinates keep the narrowing error as their
+                # residual (instead of resetting to zero) — it rides
+                # into the next round's accumulation
+                v = v.at[idx].set(vals - narrowed)
+                vals = narrowed
+            else:
+                v = v.at[idx].set(0.0)
             return loss, vals, idx, u, v
 
         @jax.jit

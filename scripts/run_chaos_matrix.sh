@@ -1,6 +1,6 @@
 #!/bin/bash
 # Chaos matrix: the vanilla-HiPS demo (12 processes, 3 parties) run
-# under six representative seeded fault plans. Every random decision
+# under seven representative seeded fault plans. Every random decision
 # is drawn from PS_SEED-derived streams (geomx_tpu/ps/faults.py), so a
 # failing case reproduces exactly by re-running with the same seed.
 # The resender is always on: the point of each case is that training
@@ -11,6 +11,8 @@
 #   wan-jitter  added latency + jitter on half the frames, 5% duplicates
 #   partition   server id 8 cut off from everyone for 3s mid-run
 #   overlap     pipelined round under drops + reordering + duplicates
+#   quant-wire  2-bit quantized combined wire (error-feedback residuals
+#               on every leg) under drops + duplicates; sanitizer on
 #   worker-kill both data parties' worker 0 crashes at round 3; elastic
 #               membership resizes the round to the survivors
 #   server-kill party A's server crashes mid-round; survivors keep
@@ -104,6 +106,25 @@ if grep -l "WIRE-SANITIZER VIOLATION" /tmp/hips_*.log 2>/dev/null; then
   echo "=== chaos[overlap] FAILED: wire-sanitizer violations (see logs above) ==="
   # the sanitizer also triggered flight-recorder dumps — collect them
   collect_artifacts overlap-sanitizer "$LAST_FDIR" "$LAST_TDIR"
+  FAILED=1
+fi
+
+# quantized combined wire under loss: every push leg carries 2-bit
+# error-feedback codes (the codec rides the async chunked rounds, so
+# the pipelined-round knobs come along). Retransmits must replay the
+# packed bytes as-sent — a retry that re-drained the residual stream
+# would corrupt the error feedback — so the bar is the same as overlap:
+# training completes AND the wire sanitizer stays silent.
+export GEOMX_WIRE_CODEC=2bit
+export GEOMX_OVERLAP=1 P3_SLICE_BYTES=131072 GEOMX_WIRE_SANITIZER=1
+run_case quant-wire \
+  '[{"type": "drop", "p": 0.1},
+    {"type": "dup", "p": 0.05}]' \
+  10090 "$@"
+unset GEOMX_WIRE_CODEC GEOMX_OVERLAP P3_SLICE_BYTES GEOMX_WIRE_SANITIZER
+if grep -l "WIRE-SANITIZER VIOLATION" /tmp/hips_*.log 2>/dev/null; then
+  echo "=== chaos[quant-wire] FAILED: wire-sanitizer violations (see logs above) ==="
+  collect_artifacts quant-wire-sanitizer "$LAST_FDIR" "$LAST_TDIR"
   FAILED=1
 fi
 

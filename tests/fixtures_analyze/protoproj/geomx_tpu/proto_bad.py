@@ -102,6 +102,25 @@ class GoodServer:
         return ranges.get(key, 0)
 
 
+def send_quantized(van, payload):
+    # GX-P307: aux-requiring codec stamped without its sidecar — the
+    # receiver cannot recover the 2-bit threshold from the codes alone
+    van.push(payload, compr="2bit")
+
+
+def send_quantized_ok(van, payload, thr):
+    van.push(payload, compr="2bit", aux=[thr])   # sidecar present: clean
+
+
+def send_rows_ok(van, payload, ids):
+    van.push(payload, compr="rsp", aux=[ids])    # clean
+
+
+def send_dense_ok(van, payload, tag):
+    van.push(payload, compr="fp16")              # self-describing: clean
+    van.push(payload, compr=tag)                 # dynamic tag: out of scope
+
+
 # GX-P306: the committed protoproj lock holds version 3 with a WRONG
 # fingerprint for these fields -> schema-changed fires.
 BINMETA_VERSION = 3

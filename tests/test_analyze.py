@@ -185,7 +185,7 @@ def test_doc_shorthand_expansion():
 
 
 # ---------------------------------------------------------------------------
-# protocol pass (GX-P301..P306)
+# protocol pass (GX-P301..P307)
 # ---------------------------------------------------------------------------
 
 @pytest.fixture(scope="module")
@@ -231,6 +231,14 @@ def test_static_count_fires(proto_findings):
     assert got == {("BadServer.check_round", "compare:num_workers"),
                    ("BadServer.start_round", "kwarg:tgt:num_workers")}
     # GoodServer.check_round uses num_live_workers() — clean
+
+
+def test_compr_without_aux_fires(proto_findings):
+    hits = _by_rule(proto_findings, "GX-P307")
+    assert [h.symbol for h in hits] == ["send_quantized"]
+    assert hits[0].detail == "van.push:2bit"
+    # the aux-carrying 2bit/rsp sites, the self-describing fp16 tag and
+    # the dynamic compr=tag form all stay clean
 
 
 def test_binmeta_schema_drift_fires(proto_findings):

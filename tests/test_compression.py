@@ -90,6 +90,48 @@ def test_two_bit_roundtrip_with_residual():
     np.testing.assert_allclose(out2, [0, 0, 0, 0, thr])
 
 
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 6, 7, 9])
+def test_two_bit_roundtrip_length_not_divisible_by_4(n):
+    """The pack pads to a whole byte (4 codes each); dequantize must
+    honor original_size exactly — no truncation, no phantom tail codes."""
+    thr = 0.25
+    rng = np.random.default_rng(n)
+    grad = rng.normal(scale=1.0, size=n).astype(np.float32)
+    residual = np.zeros(n, np.float32)
+    res_oracle = residual.copy()
+    packed = two_bit_quantize(grad.copy(), residual, thr)
+    assert packed.size == (n + 3) // 4 and packed.dtype == np.uint8
+    out = two_bit_dequantize(packed, n, thr)
+    assert out.size == n
+    # element-wise oracle: code from the residual-fed value
+    res_oracle += grad
+    expect = np.where(res_oracle > thr, thr,
+                      np.where(res_oracle < -thr, -thr, 0.0)
+                      ).astype(np.float32)
+    np.testing.assert_array_equal(out, expect)
+    np.testing.assert_allclose(residual, res_oracle - expect, atol=1e-7)
+    # pad codes beyond n must decode to nothing: a second dequantize at
+    # the padded length shows zeros past the original size
+    padded = two_bit_dequantize(packed, packed.size * 4, thr)
+    np.testing.assert_array_equal(padded[n:], 0.0)
+
+
+def test_mpq_size_lower_bound_boundary():
+    """Routing at the MXNET_KVSTORE_SIZE_LOWER_BOUND boundary: exactly
+    at the bound takes the large-tensor (BSC) route — the same
+    inclusive convention the wire codec's chunk router uses."""
+    bound = 100
+    c = MPQCompressor(threshold=1.0, size_lower_bound=bound)
+    for n, want in ((bound - 1, "fp16"), (bound, "bsc"),
+                    (bound + 1, "bsc")):
+        _, _, tag = c.compress_push(np.ones(n, np.float32), ("k", n))
+        assert tag == want, (n, tag)
+        assert c.push_tag(n) == want
+    # pull side mirrors the route
+    assert c.pull_compr_tag(bound - 1) == "fp16"
+    assert c.pull_compr_tag(bound) == "bsc"
+
+
 def test_fp16_wire_cast():
     c = FP16Compressor()
     arr = np.linspace(-3, 3, 77, dtype=np.float32)

@@ -38,6 +38,14 @@ protocol rewrite so far has broken by hand:
   fingerprinted into ``tools/analyze/binmeta.lock.json``; changing the
   schema without bumping ``BINMETA_VERSION`` (or bumping without
   refreshing the lock via ``--update-binmeta-lock``) fails the gate.
+- **GX-P307** codec without its sidecar: a send site stamping a
+  literal ``compr=`` tag whose payload is undecodable without an aux
+  operand (``2bit`` needs its threshold, ``rsp`` its row ids,
+  ``bsc16`` its indices — ``compression.device._AUX_REQUIRED``)
+  without an ``aux=`` keyword in the same call. The receiver would
+  KeyError mid-decode or, worse, decode garbage at a default
+  threshold. Dynamic tags (``compr=tag``) are out of scope — the
+  runtime wire sanitizer owns those.
 
 Pure AST, like every geomx-lint pass: the analyzed code is never
 imported.
@@ -52,7 +60,7 @@ import re
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from .core import Finding, SEV_ERROR, SourceFile, call_name
+from .core import Finding, SEV_ERROR, SourceFile, call_name, const_str
 
 BINMETA_LOCK_NAME = "binmeta.lock.json"
 
@@ -79,6 +87,7 @@ def run_protocol(sources: Sequence[SourceFile],
         findings += _check_bare_key_routing(src)
         findings += _check_unfenced_mutations(src)
         findings += _check_static_counts(src)
+        findings += _check_compr_aux(src)
     findings += _check_binmeta(sources, root)
     return findings
 
@@ -436,6 +445,44 @@ def _check_static_counts(src: SourceFile) -> List[Finding]:
                                         f"{leaf.attr}; pass the live "
                                         f"view (num_live_workers / a "
                                         f"callable) instead"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GX-P307: compr codec stamped without its aux sidecar
+# ---------------------------------------------------------------------------
+
+# codecs whose wire payload cannot be decoded without an aux operand
+# (the 2-bit threshold, row-sparse ids, bsc16 indices) — mirrors
+# compression.device._AUX_REQUIRED, restated here because geomx-lint
+# never imports the analyzed tree
+_P307_AUX_REQUIRED = {"2bit", "rsp", "bsc16"}
+
+
+def _check_compr_aux(src: SourceFile) -> List[Finding]:
+    findings = []
+    seen: Set[int] = set()
+
+    def check_call(node: ast.Call, qual: str) -> None:
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        tag = const_str(kw.get("compr"))
+        if tag not in _P307_AUX_REQUIRED or "aux" in kw:
+            return
+        findings.append(Finding(
+            "GX-P307", SEV_ERROR, src.rel, node.lineno, symbol=qual,
+            detail=f"{call_name(node.func)}:{tag}",
+            message=f"compr=\"{tag}\" stamped without its aux sidecar "
+                    f"— the {tag} payload is undecodable without it; "
+                    f"pass aux= in the same call"))
+
+    for fn, qual, _cls in _iter_functions(src.tree):
+        for node in _walk_own(fn):
+            if isinstance(node, ast.Call):
+                check_call(node, qual)
+                seen.add(id(node))
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call) and id(node) not in seen:
+            check_call(node, "<module>")
     return findings
 
 
