@@ -643,6 +643,106 @@ def bench_hips_mesh(threshold: float = 0.02, lr: float = 0.05):
         topo.stop()
 
 
+MESH_QUANT_PARITY_TOL = 5e-4
+MESH_QUANT_CODECS = ("none", "int8", "2bit", "fp16")
+
+
+def _mesh_quant_parity(codec: str, rounds: int = 200, d: int = 512,
+                       n_samples: int = 256, lr: float = 0.1,
+                       ranks: int = 4) -> float:
+    """200-round convergence probe THROUGH the jitted quantized ring:
+    4-rank linear regression, each rank's local-shard gradient enters
+    ``QuantRingReducer.reduce`` (mean), SGD applied on the replicated
+    output. codec="none" is the psum reference the quantized codecs
+    must land within MESH_QUANT_PARITY_TOL of. thr=0.01 ~ the gradient
+    scale of this problem (same reasoning as _quant_wire_parity)."""
+    import jax
+
+    from geomx_tpu.parallel.mesh import make_mesh
+    from geomx_tpu.parallel.quant_collectives import QuantRingReducer
+
+    mesh = make_mesh(jax.devices()[:ranks])
+    red = QuantRingReducer(mesh, codec, d, mean=True, threshold=0.01)
+    w_true = (np.random.RandomState(7).randn(d)
+              / np.sqrt(d)).astype(np.float32)
+    rng = np.random.RandomState(42)
+    X = rng.randn(n_samples, d).astype(np.float32)
+    y = X @ w_true
+    per = n_samples // ranks
+    Xs = X.reshape(ranks, per, d)
+    ys = y.reshape(ranks, per)
+    w = np.zeros(d, np.float32)
+    for _ in range(rounds):
+        g = np.stack([(2.0 / per) * Xs[r].T @ (Xs[r] @ w - ys[r])
+                      for r in range(ranks)]).astype(np.float32)
+        w -= lr * np.asarray(red.reduce(g))
+    r = X @ w - y
+    return float(np.mean(r * r))
+
+
+def bench_mesh_quant(n: int = 1 << 20, reps: int = 30):
+    """Quantized mesh collectives (GEOMX_MESH_CODEC): per-codec link
+    bytes/round of the intra-party all-reduce at a ~1M-param gradient,
+    the int8-vs-fp32 (and 2bit-vs-fp32) reduction ratios, the fenced
+    median ms of the collective on the 2-device party mesh, and the
+    200-round loss-parity probe. Topology-free: the ring is a device
+    program, so no van cluster is needed — 8 virtual CPU devices only
+    (this phase always self-reports platform=cpu).
+
+    Gates: int8 moves >=3.5x fewer bytes than the fp32 ring it
+    replaces (2bit >=14x), and the int8 probe's final loss lands
+    within MESH_QUANT_PARITY_TOL of the psum reference."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < 4:
+        return {"error": f"mesh_quant needs >=4 devices, backend came "
+                         f"up with {len(jax.devices())}"}
+
+    from geomx_tpu.parallel.mesh import batch_sharded, make_party_mesh
+    from geomx_tpu.parallel.quant_collectives import QuantRingReducer
+
+    mesh = make_party_mesh(2, 0)
+    g_stack = jax.device_put(
+        np.random.RandomState(0).randn(2, n).astype(np.float32),
+        batch_sharded(mesh))
+    codecs = {}
+    for codec in MESH_QUANT_CODECS:
+        red = QuantRingReducer(mesh, codec, n, mean=True)
+        jax.block_until_ready(red.reduce(g_stack))   # compile
+        samples = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(red.reduce(g_stack))
+            samples.append((time.perf_counter() - t0) * 1000.0)
+        codecs[codec] = {
+            "mesh_bytes_per_round": red.wire_bytes_per_round(),
+            "intra_party_ms": round(statistics.median(samples), 3),
+            "parity_loss": round(_mesh_quant_parity(codec), 6),
+        }
+    fp32 = codecs["none"]["mesh_bytes_per_round"]
+    red_int8 = fp32 / max(codecs["int8"]["mesh_bytes_per_round"], 1)
+    red_2bit = fp32 / max(codecs["2bit"]["mesh_bytes_per_round"], 1)
+    ref_loss = codecs["none"]["parity_loss"]
+    int8_delta = codecs["int8"]["parity_loss"] - ref_loss
+    return {
+        "grad_elems": n, "party_size": 2, "codecs": codecs,
+        "mesh_reduction_int8_vs_fp32": round(red_int8, 2),
+        "mesh_reduction_2bit_vs_fp32": round(red_2bit, 2),
+        "reduction_ok": bool(red_int8 >= 3.5 and red_2bit >= 14.0),
+        "parity": {"fp32_loss": round(ref_loss, 6),
+                   "int8_loss": round(codecs["int8"]["parity_loss"], 6),
+                   "delta": round(int8_delta, 6),
+                   "tol": MESH_QUANT_PARITY_TOL,
+                   "ok": bool(int8_delta <= MESH_QUANT_PARITY_TOL)},
+        "platform": "cpu",
+    }
+
+
 def bench_hips_hfa(hfa_k1: int = 4, hfa_k2: int = 2):
     """HFA flavor of the framework bench: workers take K1 LOCAL optimizer
     steps per LAN sync, and the party tier crosses the WAN only every K2
@@ -1169,6 +1269,7 @@ PHASES = {
     "hips_mesh": (bench_hips_mesh, 900, False),
     "hips_hfa": (bench_hips_hfa, 600, False),
     "quant_wire": (bench_quant_wire, 900, False),
+    "mesh_quant": (bench_mesh_quant, 900, False),
     "compress": (bench_compress, 600, False),
     # MFU rows precede transformer_bsc: they are ~3-5 min each on a
     # healthy tunnel, while the 59M two-worker bootstrap can eat 10-20
@@ -1378,6 +1479,17 @@ def _assemble(data: dict):
                                "reduction_ok", "parity") if k in qw}
     else:
         details["quant_wire"] = qw or {"error": "not run"}
+    mq = data.get("mesh_quant")
+    if ok(mq):
+        # the quantized-ring capture verbatim: per-codec link bytes and
+        # intra-party ms, both reduction gates, the 200-round parity
+        details["mesh_quant"] = {
+            k: mq[k] for k in ("grad_elems", "party_size", "codecs",
+                               "mesh_reduction_int8_vs_fp32",
+                               "mesh_reduction_2bit_vs_fp32",
+                               "reduction_ok", "parity") if k in mq}
+    else:
+        details["mesh_quant"] = mq or {"error": "not run"}
     details["compress"] = data.get("compress", {"error": "not run"})
     details["transformer_bsc_device"] = data.get(
         "transformer_bsc", {"error": "not run"})

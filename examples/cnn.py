@@ -80,6 +80,17 @@ def main():
     leaves, _treedef, grad_step, eval_step = build_model_and_step(
         args.batch_size, input_shape=input_shape, model=args.model)
 
+    if (getattr(kv, "type", "") == "dist_sync_mesh"
+            and getattr(kv, "mesh_codec", "none") != "none"
+            and args.model == "cnn"
+            and not getattr(kv, "is_master_worker", False)):
+        # GEOMX_MESH_CODEC: intra-party gradients ride the quantized
+        # ppermute ring instead of the fused psum (the zoo path's
+        # stateful grad_step cannot be wrapped — see utils)
+        from examples.utils import build_mesh_ring_step
+
+        grad_step = build_mesh_ring_step(kv, grad_step)
+
     start_epoch = 0
     resume_iters = 0
     if args.checkpoint_prefix:

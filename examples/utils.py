@@ -135,6 +135,50 @@ def build_model_and_step(batch_size: int, compute_dtype=jnp.float32,
             eval_step)
 
 
+def build_mesh_ring_step(kv, grad_step):
+    """Quantized mesh tier (GEOMX_MESH_CODEC != "none"): wrap the demo
+    grad_step so the batch shards over the party mesh's "dp" axis, each
+    rank computes LOCAL grads (no XLA-inserted psum), and every leaf is
+    party-mean-reduced through the store's quantized ppermute ring
+    (``kv.ring_reducer`` — error-feedback residual streams live in the
+    store, keyed, so round aborts zero them in one place). Returns a
+    drop-in ``(lv, X, y) -> (loss, grads)`` whose outputs are replicated
+    and bit-identical on every mesh rank.
+
+    Only valid for STATELESS grad_steps (the "cnn" path of
+    build_model_and_step); the zoo path mutates a host-side
+    batch_stats box per call and cannot be re-traced under shard_map.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from geomx_tpu.compat import shard_map
+
+    mesh = kv.mesh
+
+    def _local(lv, X, y):
+        loss, grads = grad_step(lv, X, y)
+        return loss[None], [g[None] for g in grads]
+
+    local_step = jax.jit(shard_map(
+        _local, mesh=mesh, in_specs=(P(), P("dp"), P("dp")),
+        out_specs=(P("dp"), P("dp")), check_vma=False))
+
+    def ring_step(lv, X, y):
+        X, y = kv.shard_batch(jnp.asarray(X), jnp.asarray(y))
+        losses, grads = local_step([jnp.asarray(l) for l in lv], X, y)
+        out = []
+        for idx, g in enumerate(grads):
+            shape = g.shape[1:]
+            n = int(np.prod(shape)) if shape else 1
+            red = kv.ring_reducer(idx, n, mean=True)
+            out.append(red.reduce(g.reshape(g.shape[0], -1))
+                       .reshape(shape))
+        kv.record_round_collectives(out, op="ring")
+        return jnp.mean(losses), out
+
+    return ring_step
+
+
 def build_flat_step(leaves: List[np.ndarray], grad_step):
     """Fuse the per-leaf param/grad transfers into ONE array each way.
 

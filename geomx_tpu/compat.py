@@ -1,0 +1,43 @@
+"""Version shims for the jax APIs this repo straddles.
+
+The codebase is written against the modern ``jax.shard_map`` entry
+point (keyword ``check_vma=``); the pinned toolchain ships jax 0.4.37,
+where shard_map still lives at ``jax.experimental.shard_map.shard_map``
+and the replication check is spelled ``check_rep=``. Everything that
+shards — ring attention, the pipeline wrapper, the flash-attention
+mesh hook, the quantized mesh collectives — imports :func:`shard_map`
+from here so one translation covers every call site.
+
+Import-lock note: this module imports only jax (never geomx_tpu.*), so
+it is safe to import from van/handler threads.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map"]
+
+
+def _resolve():
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        return native, False
+    from jax.experimental.shard_map import shard_map as legacy
+
+    return legacy, True
+
+
+_SHARD_MAP, _LEGACY = _resolve()
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kwargs):
+    """``jax.shard_map`` with the ``check_vma``/``check_rep`` spelling
+    translated to whatever this jax build expects. Keyword-only, matching
+    the modern signature every call site in the repo uses."""
+    if _LEGACY:
+        kwargs.setdefault("check_rep", check_vma)
+        return _SHARD_MAP(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kwargs)
+    return _SHARD_MAP(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=check_vma, **kwargs)

@@ -38,7 +38,10 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["WIRE_POLICIES", "WireCodec", "decode_wire", "codec_requires_aux"]
+__all__ = ["WIRE_POLICIES", "WireCodec", "decode_wire", "codec_requires_aux",
+           "MESH_CODECS", "block_quant_int8", "block_dequant_int8",
+           "block_quant_int8_np", "block_dequant_int8_np",
+           "mesh_wire_bytes"]
 
 # accepted GEOMX_WIRE_CODEC values (Config.wire_codec):
 #   ""     — off (raw fp32, the round-5 wire)
@@ -220,3 +223,114 @@ def _jnp():
     import jax.numpy as jnp
 
     return jnp
+
+
+# -- mesh-collective codecs (EQuARX) -------------------------------------
+#
+# accepted GEOMX_MESH_CODEC values (Config.mesh_codec): the quantized
+# ring all-reduce (parallel/quant_collectives.py) quantizes every hop's
+# chunk with one of these. Unlike the WireCodec above, these kernels are
+# PURE traced functions — they run INSIDE shard_map, so error-feedback
+# residuals are threaded through the jitted step explicitly by the
+# caller instead of living in a host-side dict.
+#   "none" — fp32 psum, today's PR-8 path byte-for-byte
+#   "int8" — block-scaled int8 (EQuARX default): per-block power-of-two
+#            scale (max|block|/127 rounded up to 2**k; see
+#            block_quant_int8 for why), codes round-half-even
+#   "2bit" — {0, ±threshold} codes packed 4/byte, error feedback
+#   "fp16" — half-width cast, error feedback
+MESH_CODECS = ("none", "int8", "2bit", "fp16")
+
+
+def block_quant_int8(x, block: int):
+    """Block-scaled int8 quantize of a flat f32 vector (traced).
+
+    ``x.size`` must be a multiple of ``block`` (the ring pads chunks).
+    Returns ``(codes int8 [n], exps uint8 [n/block])`` where each
+    block's scale is the POWER OF TWO ``2**(exps - 127)`` (IEEE biased
+    exponent; 0 encodes a zero block, whose bitcast scale is +0.0).
+
+    Why power-of-two scales instead of EQuARX's max/127: with
+    ``scale = 2**k`` both ``x / scale`` and ``codes * scale`` are exact
+    in f32, so the result is bit-identical whether or not the backend
+    contracts the dequantize multiply into an FMA with the ring's
+    partial-sum add (XLA CPU does, and not even
+    ``lax.optimization_barrier`` stops LLVM's fp-contract). The only
+    rounding anywhere is the round-half-even on the codes — shared
+    with the numpy twin. Cost: a quantization step at most 2x the
+    max/127 one (the error-feedback residuals absorb it); gain: the
+    sidecar is a 1-byte exponent per block instead of a 4-byte f32.
+    """
+    import jax
+
+    jnp = _jnp()
+    lax = jax.lax
+    b = jnp.asarray(x, jnp.float32).reshape(-1, block)
+    maxab = jnp.max(jnp.abs(b), axis=1)
+    t = maxab * jnp.float32(1.0 / 127.0)
+    bits = lax.bitcast_convert_type(t, jnp.int32)
+    mant = bits & jnp.int32(0x7FFFFF)
+    # round t UP to a power of two: bump the biased exponent when any
+    # mantissa bit is set (subnormal t lands on 2**-126 via exp 0 -> 1)
+    e2 = ((bits >> 23) & jnp.int32(0xFF)) + jnp.where(mant != 0, 1, 0)
+    scale = lax.bitcast_convert_type(e2 << 23, jnp.float32)
+    safe = jnp.where(maxab > 0, scale, jnp.float32(1.0))
+    codes = jnp.round(b / safe[:, None]).astype(jnp.int8)
+    exps = jnp.where(maxab > 0, e2, 0).astype(jnp.uint8)
+    return codes.reshape(-1), exps
+
+
+def block_dequant_int8(codes, exps, block: int):
+    """Inverse of :func:`block_quant_int8` (traced). Exact: int8 times
+    a power of two never rounds."""
+    import jax
+
+    jnp = _jnp()
+    scales = jax.lax.bitcast_convert_type(
+        exps.astype(jnp.int32) << 23, jnp.float32)
+    c = codes.reshape(-1, block).astype(jnp.float32)
+    return (c * scales[:, None]).reshape(-1)
+
+
+def block_quant_int8_np(x: np.ndarray, block: int):
+    """numpy twin of :func:`block_quant_int8` — the per-hop oracle.
+
+    Operation-for-operation identical (f32 arithmetic, round-half-even
+    via ``np.rint``, same exponent bit-twiddling) so the ring result is
+    bit-exact against a host replay of quantize→sum→dequantize."""
+    b = np.asarray(x, np.float32).reshape(-1, block)
+    maxab = np.max(np.abs(b), axis=1)
+    t = (maxab * np.float32(1.0 / 127.0)).astype(np.float32)
+    bits = t.view(np.int32)
+    mant = bits & np.int32(0x7FFFFF)
+    e2 = ((bits >> 23) & np.int32(0xFF)) + np.where(mant != 0, 1, 0)
+    scale = (e2 << 23).astype(np.int32).view(np.float32)
+    safe = np.where(maxab > 0, scale, np.float32(1.0)).astype(np.float32)
+    codes = np.rint(b / safe[:, None]).astype(np.int8)
+    exps = np.where(maxab > 0, e2, 0).astype(np.uint8)
+    return codes.reshape(-1), exps
+
+
+def block_dequant_int8_np(codes: np.ndarray, exps: np.ndarray,
+                          block: int) -> np.ndarray:
+    scales = (np.asarray(exps).astype(np.int32) << 23).view(np.float32)
+    c = np.asarray(codes, np.int8).reshape(-1, block).astype(np.float32)
+    return (c * scales[:, None]).reshape(-1)
+
+
+def mesh_wire_bytes(codec: str, n_elems: int, block: int) -> int:
+    """Bytes one quantized ring hop moves for a chunk of ``n_elems``
+    f32 elements — the honest per-codec model behind the
+    ``mesh.bytes{codec=...}`` telemetry counters (codes + sidecar
+    scales/threshold, not the fp32 it replaced)."""
+    if codec in ("none", ""):
+        return 4 * n_elems
+    if codec == "int8":
+        blocks = -(-n_elems // max(1, block))
+        return n_elems + blocks        # 1 B/code + 1-byte exponent/block
+    if codec == "2bit":
+        return -(-n_elems // 4) + 4            # 4 codes/byte + f32 threshold
+    if codec == "fp16":
+        return 2 * n_elems
+    raise ValueError(
+        f"GEOMX_MESH_CODEC={codec!r}: expected one of {MESH_CODECS}")

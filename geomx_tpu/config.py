@@ -238,6 +238,21 @@ class Config:
     # (tests/bench: 8 virtual CPU devices, 2 parties) each party takes
     # a disjoint slice of this size
     party_mesh_size: int = 0            # GEOMX_PARTY_MESH_SIZE
+    # quantized mesh collective (EQuARX proper): codec for the
+    # intra-party all-reduce INSIDE the jitted step — "none" keeps the
+    # PR-8 fp32 psum byte-for-byte; "int8" (block-scaled ring), "2bit"
+    # (error-feedback ring), "fp16" replace it with the shard_map +
+    # ppermute ring of parallel/quant_collectives.py
+    mesh_codec: str = "none"            # GEOMX_MESH_CODEC
+    # block size for the int8 mesh codec's power-of-two block scales
+    mesh_block: int = 256               # GEOMX_MESH_BLOCK
+    # multi-host mesh (run_mesh_multihost.sh): when set, the mesh
+    # worker calls jax.distributed.initialize(coordinator, nprocs,
+    # procid) before building the party mesh, and the GLOBAL worker is
+    # the one with jax.process_index() == 0 instead of local rank 0
+    mesh_coordinator: str = ""          # GEOMX_MESH_COORDINATOR (host:port)
+    mesh_num_processes: int = 0         # GEOMX_MESH_NUM_PROCS (0 = single)
+    mesh_process_id: int = -1           # GEOMX_MESH_PROC_ID
 
     # ---- quantized combined wire (ours; docs/env-var-summary.md
     # "Quantized wire" + PERF.md "quantized wire") ----
@@ -353,6 +368,11 @@ def load() -> Config:
         overlap=env_bool("GEOMX_OVERLAP", True),
         party_mesh=env_bool("GEOMX_PARTY_MESH"),
         party_mesh_size=env_int("GEOMX_PARTY_MESH_SIZE", 0),
+        mesh_codec=env_str("GEOMX_MESH_CODEC", "none"),
+        mesh_block=env_int("GEOMX_MESH_BLOCK", 256),
+        mesh_coordinator=env_str("GEOMX_MESH_COORDINATOR"),
+        mesh_num_processes=env_int("GEOMX_MESH_NUM_PROCS", 0),
+        mesh_process_id=env_int("GEOMX_MESH_PROC_ID", -1),
         wire_codec=env_str("GEOMX_WIRE_CODEC"),
         wire_codec_wan=env_str("GEOMX_WIRE_CODEC_WAN"),
         wire_2bit_threshold=env_float("GEOMX_WIRE_2BIT_THRESHOLD", 0.5),

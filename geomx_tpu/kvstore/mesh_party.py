@@ -59,12 +59,40 @@ def _ring_bytes(party_size: int, nbytes: int) -> int:
     return 2 * max(0, party_size - 1) * int(nbytes)
 
 
+def maybe_init_multihost(cfg) -> bool:
+    """``jax.distributed.initialize`` from the GEOMX_MESH_* env knobs.
+
+    Returns True when this process joined a multi-process mesh (after
+    which ``jax.process_index()`` is real and picks the global worker).
+    No-ops on single-process runs (the knobs unset) and on repeat calls.
+    """
+    if not cfg.mesh_coordinator or cfg.mesh_num_processes <= 1:
+        return False
+    import jax
+
+    try:  # jax<0.5 keeps the handle under jax._src only
+        state = jax.distributed.global_state
+    except AttributeError:
+        from jax._src.distributed import global_state as state
+    if getattr(state, "client", None) is not None:
+        return True   # already initialized (idempotent re-entry)
+    jax.distributed.initialize(
+        coordinator_address=cfg.mesh_coordinator,
+        num_processes=cfg.mesh_num_processes,
+        process_id=cfg.mesh_process_id)
+    return True
+
+
 class KVStorePartyMesh(KVStore):
     def __init__(self, sync_global: bool = True,
                  cfg: Optional[cfg_mod.Config] = None,
                  mesh=None, party_index: int = 0):
         super().__init__()
         self.cfg = cfg or cfg_mod.load()
+        # multi-host ICI (run_mesh_multihost.sh): join the process group
+        # BEFORE building the mesh so jax.devices()/process_index() see
+        # the whole party
+        maybe_init_multihost(self.cfg)
         if mesh is None:
             from geomx_tpu.parallel.mesh import make_party_mesh
 
@@ -76,6 +104,24 @@ class KVStorePartyMesh(KVStore):
         # single-controller per party in-process; on multi-host meshes
         # process 0 of the party is the van speaker
         self._is_global_worker = jax.process_index() == 0
+        # quantized mesh collective (GEOMX_MESH_CODEC): "none" keeps the
+        # fused GSPMD psum byte-for-byte; other codecs route gradient
+        # all-reduces through the quantized ppermute ring, one stateful
+        # reducer (= one set of error-feedback residual streams) per key
+        from geomx_tpu.compression.device import MESH_CODECS
+
+        self.mesh_codec = self.cfg.mesh_codec or "none"
+        if self.mesh_codec not in MESH_CODECS:
+            raise ValueError(
+                f"GEOMX_MESH_CODEC={self.mesh_codec!r}: expected one of "
+                f"{MESH_CODECS}")
+        self.mesh_block = int(self.cfg.mesh_block)
+        self._reducers: Dict = {}
+        # trainers holding their own device-resident ring residuals
+        # (DeviceResidentTrainer threads them through its jitted step)
+        # register here so abort recovery zeroes EVERY residual stream,
+        # not just the store-keyed reducers
+        self._residual_reset_hooks: list = []
         # the party's ONLY van-speaking worker: the shell reuses the
         # whole wire/membership/trace machinery unchanged
         self.inner = KVStoreDist(sync_global=sync_global, cfg=self.cfg)
@@ -150,14 +196,58 @@ class KVStorePartyMesh(KVStore):
                     for a in arrays)
         return out[0] if len(out) == 1 else out
 
+    def ring_reducer(self, key, n: int, mean: bool = False):
+        """The per-key quantized ring reducer (residual lifecycle lives
+        here: one reducer = one set of error-feedback streams per key,
+        never mixed across keys, rebuilt when an elastic resize changes
+        the vector length). None when the codec is "none" — callers
+        keep the fused-psum path untouched."""
+        if self.mesh_codec == "none":
+            return None
+        from geomx_tpu.parallel.quant_collectives import QuantRingReducer
+
+        n = int(n)
+        red = self._reducers.get(key)
+        if red is None or red.n != n or red.mean != bool(mean):
+            red = QuantRingReducer(
+                self.mesh, self.mesh_codec, n, block=self.mesh_block,
+                threshold=self.cfg.wire_2bit_threshold, mean=mean)
+            self._reducers[key] = red
+        return red
+
+    def register_residual_reset_hook(self, fn) -> None:
+        """Callback run by :meth:`reset_mesh_residuals` — for trainers
+        that thread their OWN ring residual through the jitted step
+        instead of borrowing a store-keyed reducer."""
+        self._residual_reset_hooks.append(fn)
+
+    def reset_mesh_residuals(self) -> None:
+        """Zero every key's ring residual streams — abort/membership
+        recovery re-seeds from zero rather than replaying stale error
+        (the WireCodec.reset policy applied to the mesh tier; an abort
+        loses at most the one drained quantized step)."""
+        for red in self._reducers.values():
+            red.reset()
+        for fn in self._residual_reset_hooks:
+            fn()
+
     def count_collective(self, nbytes: int, op: str = "psum",
                          n_msgs: int = 1) -> None:
-        """Account one fused mesh collective of ``nbytes`` payload under
-        the tier=mesh counter family (never tier=global: wan_bytes()
-        must stay honest about what actually crossed the WAN)."""
-        telemetry.counter_inc("mesh.bytes",
-                              _ring_bytes(self.party_size, nbytes),
-                              tier="mesh", op=op)
+        """Account one fused mesh collective of ``nbytes`` fp32 payload
+        under the tier=mesh counter family (never tier=global:
+        wan_bytes() must stay honest about what actually crossed the
+        WAN). With a quantized codec the ring model counts what the
+        hops actually move — codes plus the exponent/threshold sidecar
+        — under its own codec= label."""
+        if self.mesh_codec == "none":
+            wire = _ring_bytes(self.party_size, nbytes)
+        else:
+            from geomx_tpu.parallel.quant_collectives import ring_wire_bytes
+
+            wire = ring_wire_bytes(self.mesh_codec, int(nbytes) // 4,
+                                   self.party_size, self.mesh_block)
+        telemetry.counter_inc("mesh.bytes", wire, tier="mesh", op=op,
+                              codec=self.mesh_codec)
         telemetry.counter_inc("mesh.messages", n_msgs, tier="mesh", op=op)
 
     def record_round_collectives(self, leaves, op: str = "psum") -> None:
@@ -181,6 +271,9 @@ class KVStorePartyMesh(KVStore):
         RoundAborted, which the trainer's re-issue loop handles)."""
         for fut in list(self._live_futs):
             fut.abort_pending(f"round aborted: {reason}")
+        # the aborted round's drained quantized step is lost; stale
+        # error must not replay into the retried round
+        self.reset_mesh_residuals()
 
     def _watch(self, fut: RoundFuture) -> RoundFuture:
         self._live_futs.add(fut)

@@ -38,23 +38,14 @@ def make_attention(impl: str = "auto", *, causal: bool = True,
     if impl == "auto":
         impl = "flash" if jax.default_backend() == "tpu" else "dense"
     if impl == "flash":
-        from geomx_tpu.ops.flash_attention import flash_attention
+        from geomx_tpu.ops.flash_attention import (
+            flash_attention, make_sharded_flash_attention)
 
-        fn = lambda q, k, v: flash_attention(  # noqa: E731
-            q, k, v, causal=causal, block_q=block_q, block_k=block_k)
         if mesh is not None and mesh.devices.size > 1:
-            if "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
-                raise ValueError(
-                    "flash attention cannot shard the sequence axis; "
-                    "use parallel.make_ring_attention for sp > 1")
-            spec = P(("dp",) if "dp" in mesh.axis_names else None, None,
-                     "tp" if "tp" in mesh.axis_names else None, None)
-            # check_vma=False: pallas_call outputs carry no varying-mesh-
-            # axes annotation, and the kernel touches no collectives
-            return jax.shard_map(fn, mesh=mesh,
-                                 in_specs=(spec, spec, spec),
-                                 out_specs=spec, check_vma=False)
-        return fn
+            return make_sharded_flash_attention(
+                mesh, causal=causal, block_q=block_q, block_k=block_k)
+        return lambda q, k, v: flash_attention(  # noqa: E731
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k)
     if impl == "dense":
         return lambda q, k, v: dense_attention(q, k, v, causal=causal)
     raise ValueError(f"unknown attention impl {impl!r}")

@@ -32,7 +32,7 @@ from __future__ import annotations
 
 import functools
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "make_sharded_flash_attention"]
 
 
 @functools.lru_cache(maxsize=None)
@@ -346,3 +346,31 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
 
     _attn.defvjp(_attn_fwd, _attn_bwd)
     return _attn(q, k, v)
+
+
+def make_sharded_flash_attention(mesh, *, causal: bool = True,
+                                 block_q: int = 128, block_k: int = 128):
+    """shard_map-wrap :func:`flash_attention` over ``mesh`` (dp/tp).
+
+    A Pallas kernel has no SPMD partitioning rule, so under jit with
+    sharded operands the kernel must run per-shard. Attention is
+    independent per batch ("dp") and head ("tp"); sequence-sharded
+    meshes ("sp" > 1) need ring attention instead and are rejected.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from geomx_tpu.compat import shard_map
+
+    if "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
+        raise ValueError(
+            "flash attention cannot shard the sequence axis; "
+            "use parallel.make_ring_attention for sp > 1")
+    fn = functools.partial(flash_attention, causal=causal,
+                           block_q=block_q, block_k=block_k)
+    spec = P(("dp",) if "dp" in mesh.axis_names else None, None,
+             "tp" if "tp" in mesh.axis_names else None, None)
+    # check_vma=False: pallas_call outputs carry no varying-mesh-axes
+    # annotation, and the kernel touches no collectives
+    return shard_map(lambda q, k, v: fn(q, k, v), mesh=mesh,
+                     in_specs=(spec, spec, spec), out_specs=spec,
+                     check_vma=False)

@@ -73,6 +73,28 @@ def make_party_mesh(party_size: int = 0, party_index: int = 0,
     return make_mesh(party_devices(party_size, party_index, devices))
 
 
+def ring_perm(size: int):
+    """ppermute permutation for one unidirectional ring hop: every rank
+    forwards to its successor. Both phases of the quantized ring
+    all-reduce (quant_collectives) hop along this."""
+    return [(i, (i + 1) % size) for i in range(size)]
+
+
+def ring_chunk_layout(n: int, size: int, multiple: int = 1
+                      ) -> Tuple[int, int]:
+    """Chunking for an n-element ring all-reduce over ``size`` ranks.
+
+    Returns ``(m, padded)``: each rank owns one m-element chunk, with m
+    rounded up to ``multiple`` (codec packing granularity — int8 block
+    size, 4 for 2-bit packing) and ``padded = size * m >= n`` the
+    zero-padded total the vector is reshaped to.
+    """
+    m = -(-n // size)
+    mult = max(1, int(multiple))
+    m = -(-m // mult) * mult
+    return m, size * m
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

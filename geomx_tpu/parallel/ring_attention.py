@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from geomx_tpu.compat import shard_map
+
 NEG_INF = -1e30
 
 
@@ -101,7 +103,7 @@ def make_ring_attention(mesh: Mesh, *, causal: bool = False,
     """
     spec = q_spec or P("dp", "sp", "tp", None)
     fn = functools.partial(ring_attention, causal=causal)
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )

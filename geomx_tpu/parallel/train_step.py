@@ -57,6 +57,21 @@ class DataParallelTrainer:
         def grad_step(p, X, y):
             return jax.value_and_grad(loss_fn)(p, X, y)
 
+        # per-rank LOCAL grads (no psum): the quantized-ring mesh path
+        # replaces XLA's inserted collective with an explicit one, so it
+        # needs each rank's un-reduced contribution, stacked on a
+        # leading "dp" axis the ring's shard_map then consumes
+        from geomx_tpu.compat import shard_map
+
+        def _local(p, X, y):
+            loss, grads = jax.value_and_grad(loss_fn)(p, X, y)
+            return (loss[None],
+                    jax.tree_util.tree_map(lambda g: g[None], grads))
+
+        self._local_grad_step = jax.jit(shard_map(
+            _local, mesh=mesh, in_specs=(P(), P("dp"), P("dp")),
+            out_specs=(P("dp"), P("dp")), check_vma=False))
+
         self._train_step = train_step
         self._grad_step = grad_step
 
@@ -74,6 +89,13 @@ class DataParallelTrainer:
         """Mesh-aggregated (mean) gradients — tier-1 output for tier-2."""
         X, y = self.shard_batch(X, y)
         return self._grad_step(self.params, X, y)
+
+    def local_grads(self, X, y):
+        """Per-rank local mean grads, each leaf stacked ``(P, *shape)``
+        over "dp" (NOT reduced — feed these to the quantized ring);
+        losses come back ``(P,)``, one per rank."""
+        X, y = self.shard_batch(X, y)
+        return self._local_grad_step(self.params, X, y)
 
 
 class HierarchicalTrainer:
@@ -116,6 +138,9 @@ class HierarchicalTrainer:
             jax.tree_util.tree_unflatten(self.treedef, leaves), self.t.repl)
 
     def step(self, X, y) -> float:
+        if self._mesh_store and \
+                getattr(self.kv, "mesh_codec", "none") != "none":
+            return self._step_mesh_quant(X, y)
         loss, grads = self.t.grads(X, y)
         glist = jax.tree_util.tree_leaves(grads)
         if self._mesh_store:
@@ -127,6 +152,25 @@ class HierarchicalTrainer:
         self.kv.wait()
         self._install()
         return float(loss)
+
+    def _step_mesh_quant(self, X, y) -> float:
+        """Quantized mesh round (GEOMX_MESH_CODEC != "none"): per-rank
+        local grads go through one quantized ppermute ring PER KEY
+        (``kv.ring_reducer`` — the error-feedback residual streams live
+        in the store, keyed, so round aborts and elastic resizes reset
+        them in one place) instead of the XLA-inserted fp32 psum. The
+        ring output is replicated and bit-identical on every rank; the
+        van leg and telemetry accounting are the unchanged
+        :meth:`_step_mesh`."""
+        losses, grads = self.t.local_grads(X, y)
+        glist = []
+        for idx, g in enumerate(jax.tree_util.tree_leaves(grads)):
+            shape = g.shape[1:]
+            n = int(np.prod(shape)) if shape else 1
+            red = self.kv.ring_reducer(idx, n, mean=True)
+            glist.append(red.reduce(g.reshape(g.shape[0], -1))
+                         .reshape(shape))
+        return self._step_mesh(glist, jnp.mean(losses))
 
     def _step_mesh(self, glist, loss) -> float:
         """Mesh-party round: the intra-party aggregation already

@@ -520,6 +520,24 @@ class Van:
                 self._process(self._reframe(msg, t))
                 continue
             m = self._reframe(msg, t)
+            if (not m.is_control and t != self.my_id
+                    and t in self._declared_dead):
+                # fail-fast: a data frame to a declared-dead peer never
+                # enters the send pipeline (e.g. a deferred chained
+                # pull whose push "ack" was the give-up itself). With
+                # the resender on, register the frame so the monitor
+                # fails it on its next cycle with the declared-dead
+                # reason — the terminal state a wire attempt would
+                # reach, minus the doomed frame; without it the caller
+                # sees the OSError a dead TCP peer would produce.
+                if (self._resender is not None and m.meta.msg_sig == 0
+                        and m.meta.control_cmd != Control.ACK
+                        and self.my_id >= 0):
+                    self._resender.assign_sig(m)
+                    self._resender.add_outgoing(t, m)
+                    continue
+                raise OSError(
+                    f"send to node {t}: peer declared dead")
             if self.sanitizer is not None:
                 # before the DGT split so the logical message is recorded
                 # once, not per block
@@ -625,6 +643,19 @@ class Van:
                 and self.my_id >= 0 and target != self.my_id):
             self._resender.assign_sig(msg)
             self._resender.add_outgoing(target, msg)
+        if not msg.is_control and target in self._declared_dead:
+            # fail-fast: a data frame to a declared-dead peer must not
+            # touch the wire (sanitizer send-to-dead — e.g. a deferred
+            # chained pull whose push "ack" was the give-up itself).
+            # With the resender on, the frame is registered above, so
+            # the monitor fails it on its next cycle with the
+            # declared-dead reason — the same terminal state a wire
+            # attempt would reach, minus the doomed frame; without the
+            # resender the caller sees the OSError a dead TCP peer
+            # would have produced.
+            if self._resender is not None and msg.meta.msg_sig != 0:
+                return 0
+            raise OSError(f"send to node {target}: peer declared dead")
         buf = msg.pack()
         if not msg.is_control:
             self._note_wire("sent", target, msg.meta, len(buf))

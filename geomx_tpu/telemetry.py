@@ -249,6 +249,28 @@ def mesh_bytes(snap: Optional[Dict[str, Any]] = None) -> float:
     return total
 
 
+def mesh_bytes_by_codec(snap: Optional[Dict[str, Any]] = None
+                        ) -> Dict[str, float]:
+    """Mesh-tier collective bytes broken out per GEOMX_MESH_CODEC —
+    the ``codec=`` label on the same ``mesh.bytes{tier=mesh,...}``
+    counters :func:`mesh_bytes` sums ("none" = the fp32 psum model;
+    "int8"/"2bit"/"fp16" = the quantized ring's codes + sidecar)."""
+    if snap is None:
+        snap = snapshot()
+    out: Dict[str, float] = {}
+    for key, v in snap.get("counters", {}).items():
+        if not (key.startswith("mesh.bytes{") and "tier=mesh" in key):
+            continue
+        codec = "none"
+        inner = key[key.index("{") + 1:key.rindex("}")]
+        for part in inner.split(","):
+            if part.startswith("codec="):
+                codec = part[len("codec="):]
+                break
+        out[codec] = out.get(codec, 0.0) + v
+    return out
+
+
 def reset() -> None:
     global _enabled, _export_dir
     with _lock:

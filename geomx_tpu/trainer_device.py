@@ -153,14 +153,24 @@ class DeviceResidentTrainer:
         # rounding error goes BACK into the residual v instead of being
         # dropped on the host cast, so the wire's astype(float16) in
         # dist._prepare_bsc_shards is exactly lossless
-        wire16 = bool(getattr(getattr(self.kv, "cfg", None),
-                              "wire_codec", ""))
+        kcfg0 = getattr(self.kv, "cfg", None)
+        wire16 = bool(getattr(kcfg0, "wire_codec", ""))
 
-        def select(flat, u, v, X, y):
+        # quantized mesh collective (GEOMX_MESH_CODEC != "none"): the
+        # party aggregate moves off the XLA-inserted fp32 psum and onto
+        # the explicit quantized ppermute ring — set up below, after
+        # the shared BSC body is defined
+        mesh_codec = (getattr(kcfg0, "mesh_codec", "none") or "none") \
+            if self._mesh is not None else "none"
+        self._mesh_quant = mesh_codec != "none"
+
+        def _grad_cat(flat, X, y):
             lv = [p.reshape(s) for p, s in
                   zip(jnp.split(flat, bounds), shapes)]
             loss, grads = grad_fn(lv, X, y)
-            g = jnp.concatenate([gg.reshape(-1) for gg in grads]) / nw
+            return loss, jnp.concatenate([gg.reshape(-1) for gg in grads])
+
+        def _bsc(loss, g, u, v):
             # BSC: momentum-corrected accumulation, exact per-key top-k
             # (reference: gradient_compression.cc:191-268, per tensor)
             u = 0.9 * u + g
@@ -184,6 +194,10 @@ class DeviceResidentTrainer:
             else:
                 v = v.at[idx].set(0.0)
             return loss, vals, idx, u, v
+
+        def select(flat, u, v, X, y):
+            loss, g = _grad_cat(flat, X, y)
+            return _bsc(loss, g / nw, u, v)
 
         @jax.jit
         def fwd_compress(flat, u, v, X, y):
@@ -283,6 +297,126 @@ class DeviceResidentTrainer:
             self._fwd_chunks = fwd_chunks
             self._apply_chunk = apply_chunk
 
+        # -- quantized mesh collective (GEOMX_MESH_CODEC) ----------------
+        # The psum XLA inserts for the dp-sharded mean loss moves the
+        # dense fp32 gradient; with a codec the party aggregate becomes
+        # an explicit shard_map: each rank takes the grad of its LOCAL
+        # shard's mean loss, the quantized ppermute ring sums across
+        # ranks (error-feedback residual threaded through the jitted
+        # step), and /P restores the party mean the psum produced. The
+        # ring output is bit-identical on every rank by construction,
+        # so the BSC selection downstream stays replica-coherent.
+        if self._mesh_quant:
+            from jax.sharding import NamedSharding
+
+            from geomx_tpu.compat import shard_map
+            from geomx_tpu.parallel import quant_collectives as qc
+            from geomx_tpu.parallel.mesh import P as _P
+
+            psize = int(self._mesh.shape["dp"])
+            mesh_block = int(getattr(kcfg0, "mesh_block", 256) or 256)
+            thr = float(getattr(kcfg0, "wire_2bit_threshold", 0.5))
+            self._mesh_size = psize
+            self._mesh_codec = mesh_codec
+            self._mesh_block = mesh_block
+            # captured HERE so _reset_mesh_residual never imports on a
+            # handler thread (round_abort_hook runs on the van side and
+            # infra threads can hold the package import lock)
+            mesh0 = self._mesh
+
+            def _zero_res():
+                return jax.device_put(
+                    qc.zero_residual(psize, self.total, mesh_codec,
+                                     mesh_block),
+                    NamedSharding(mesh0, _P("dp")))
+
+            self._zero_mesh_res = _zero_res
+
+            def _mesh_grad_body(flat, X, y, res):
+                loss, gl = _grad_cat(flat, X, y)
+                gs, new_res = qc.ring_all_reduce(
+                    gl, res[0], size=psize, axis_name="dp",
+                    codec=mesh_codec, block=mesh_block, threshold=thr)
+                loss = jax.lax.psum(loss, "dp") / psize
+                return loss, gs / psize, new_res[None]
+
+            mesh_grad = shard_map(
+                _mesh_grad_body, mesh=self._mesh,
+                in_specs=(_P(), _P("dp"), _P("dp"), _P("dp")),
+                out_specs=(_P(), _P(), _P("dp")), check_vma=False)
+
+            def select_q(flat, u, v, X, y, res):
+                loss, g, res = mesh_grad(flat, X, y, res)
+                loss, vals, idx, u, v = _bsc(loss, g / nw, u, v)
+                return loss, vals, idx, u, v, res
+
+            @jax.jit
+            def fwd_compress_q(flat, u, v, X, y, res):
+                loss, vals, idx, u, v, res = select_q(flat, u, v,
+                                                      X, y, res)
+                packed = jnp.concatenate(
+                    [jax.lax.bitcast_convert_type(
+                        loss[None].astype(jnp.float32), jnp.int32),
+                     jax.lax.bitcast_convert_type(vals, jnp.int32),
+                     idx])
+                return packed, u, v, res
+
+            self._fwd_compress_q = fwd_compress_q
+            if self._pipeline:
+                sel_bounds_q = [(mm[0], mm[1]) for mm in self._chunk_meta]
+
+                @jax.jit
+                def fwd_chunks_q(flat, u, v, X, y, res):
+                    loss, vals, idx, u, v, res = select_q(flat, u, v,
+                                                          X, y, res)
+                    packs = tuple(
+                        jnp.concatenate(
+                            [jax.lax.bitcast_convert_type(vals[lo:hi],
+                                                          jnp.int32),
+                             idx[lo:hi]])
+                        for lo, hi in sel_bounds_q)
+                    return loss.astype(jnp.float32), packs, u, v, res
+
+                self._fwd_chunks_q = fwd_chunks_q
+            self._reset_mesh_residual()
+            # abort recovery zeroes this trainer's residual along with
+            # the store-keyed reducers
+            if hasattr(self.kv, "register_residual_reset_hook"):
+                self.kv.register_residual_reset_hook(
+                    self._reset_mesh_residual)
+
+    def _reset_mesh_residual(self) -> None:
+        """(Re-)seed the ring's error-feedback streams at zero — round
+        aborts must not replay stale error into the retried round.
+        Import-free: safe from the store's round_abort_hook (which runs
+        on van/handler threads)."""
+        if not self._mesh_quant:
+            return
+        self._mesh_res = self._zero_mesh_res()
+
+    def _run_fwd_compress(self, X, y):
+        """Run the monolithic device step, advancing (u, v) and — on the
+        quantized mesh path — the ring residual."""
+        if self._mesh_quant:
+            packed, self._u, self._v, self._mesh_res = \
+                self._fwd_compress_q(self._flat, self._u, self._v,
+                                     X, y, self._mesh_res)
+        else:
+            packed, self._u, self._v = self._fwd_compress(
+                self._flat, self._u, self._v, X, y)
+        return packed
+
+    def _run_fwd_chunks(self, X, y):
+        """Chunked twin of :meth:`_run_fwd_compress`."""
+        if self._mesh_quant:
+            loss_d, packs, self._u, self._v, self._mesh_res = \
+                self._fwd_chunks_q(self._flat, self._u, self._v,
+                                   X, y, self._mesh_res)
+        else:
+            loss_d, packs, self._u, self._v = self._fwd_chunks(
+                self._flat, self._u, self._v, X, y)
+        return loss_d, packs
+
     def _place_batch(self, X, y):
         """Mesh mode: shard the batch over the party's dp axis (the
         psum in grad_fn's backward then aggregates across mesh ranks);
@@ -314,14 +448,22 @@ class DeviceResidentTrainer:
         import jax
 
         X, y = self._place_batch(X, y)
-        packed, _u, _v = self._fwd_compress(self._flat, self._u,
-                                            self._v, X, y)
+        if self._mesh_quant:
+            packed, _u, _v, _res = self._fwd_compress_q(
+                self._flat, self._u, self._v, X, y, self._mesh_res)
+        else:
+            packed, _u, _v = self._fwd_compress(self._flat, self._u,
+                                                self._v, X, y)
         up = jax.device_put(np.zeros(2 * self._up_cap, np.int32))
         flat2, _mom2 = self._apply(self._flat, self._mom, up)
         fence = [packed, flat2]
         if self._pipeline:
-            loss_d, packs, _u2, _v2 = self._fwd_chunks(
-                self._flat, self._u, self._v, X, y)
+            if self._mesh_quant:
+                loss_d, packs, _u2, _v2, _res2 = self._fwd_chunks_q(
+                    self._flat, self._u, self._v, X, y, self._mesh_res)
+            else:
+                loss_d, packs, _u2, _v2 = self._fwd_chunks(
+                    self._flat, self._u, self._v, X, y)
             fence.extend([loss_d, *packs])
             for _lo, _hi, flo, fsize, cap in self._chunk_meta:
                 up0 = jax.device_put(np.zeros(2 * cap, np.int32))
@@ -346,8 +488,7 @@ class DeviceResidentTrainer:
         self._count_mesh_round()
         if self._pipeline:
             return self._step_pipelined(X, y)
-        packed_d, self._u, self._v = self._fwd_compress(
-            self._flat, self._u, self._v, X, y)
+        packed_d = self._run_fwd_compress(X, y)
         # ONE compact device->host transfer (1 + 2K int32 vs total)
         packed = np.asarray(packed_d)
         loss = float(packed[:1].view(np.float32)[0])
@@ -421,8 +562,7 @@ class DeviceResidentTrainer:
         the serial path."""
         import jax
 
-        loss_d, packs, self._u, self._v = self._fwd_chunks(
-            self._flat, self._u, self._v, X, y)
+        loss_d, packs = self._run_fwd_chunks(X, y)
         for p in packs:
             if hasattr(p, "copy_to_host_async"):
                 p.copy_to_host_async()
@@ -464,8 +604,7 @@ class DeviceResidentTrainer:
         self._count_mesh_round()
         t0 = time.perf_counter()
         if self._pipeline:
-            loss_d, packs, self._u, self._v = self._fwd_chunks(
-                self._flat, self._u, self._v, X, y)
+            loss_d, packs = self._run_fwd_chunks(X, y)
             loss = float(np.asarray(loss_d))   # fences the fwd program
             t1 = time.perf_counter()
             arrs = [np.asarray(p) for p in packs]
@@ -485,8 +624,7 @@ class DeviceResidentTrainer:
                 self._flat, self._mom = self._apply_chunk(
                     self._flat, self._mom, up_d, flo, fsize)
         else:
-            packed_d, self._u, self._v = self._fwd_compress(
-                self._flat, self._u, self._v, X, y)
+            packed_d = self._run_fwd_compress(X, y)
             loss = float(np.asarray(packed_d[0:1])
                          .view(np.float32)[0])  # value fetch = fence
             t1 = time.perf_counter()

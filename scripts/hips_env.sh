@@ -31,6 +31,25 @@ NH_B=$([ "$HOST_B" = "127.0.0.1" ] || echo "DMLC_NODE_HOST=$HOST_B")
 GLOBALS="DMLC_PS_GLOBAL_ROOT_URI=$HOST_CENTRAL DMLC_PS_GLOBAL_ROOT_PORT=$GPORT \
 DMLC_NUM_GLOBAL_SERVER=$NGS DMLC_NUM_GLOBAL_WORKER=2"
 
+# one data-party server. If CHAOS_PLAN_SERVER_A is set, party A's
+# server (and ONLY it) runs under its own fault plan — a node/tier
+# match alone cannot single it out (every party's server is local id 8)
+launch_hips_party_server() {
+  local PPORT="$1" PHOST="$2" NH_P="$3" NWORK="$4"
+  if [ "$PPORT" = "$APORT" ] && [ -n "${CHAOS_PLAN_SERVER_A:-}" ]; then
+    env $(echo $GLOBALS) $NH_P DMLC_ROLE=server \
+      DMLC_PS_ROOT_URI=$PHOST DMLC_PS_ROOT_PORT=$PPORT \
+      DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=$NWORK \
+      PS_FAULT_PLAN="$CHAOS_PLAN_SERVER_A" \
+      $PYTHON -c "import geomx_tpu" > /tmp/hips_server_$PPORT.log 2>&1 &
+  else
+    env $(echo $GLOBALS) $NH_P DMLC_ROLE=server \
+      DMLC_PS_ROOT_URI=$PHOST DMLC_PS_ROOT_PORT=$PPORT \
+      DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=$NWORK \
+      $PYTHON -c "import geomx_tpu" > /tmp/hips_server_$PPORT.log 2>&1 &
+  fi
+}
+
 launch_hips() {
   local script="$1"; shift
   local extra="$@"
@@ -61,21 +80,7 @@ launch_hips() {
     env $NH_P DMLC_ROLE=scheduler DMLC_PS_ROOT_URI=$PHOST DMLC_PS_ROOT_PORT=$PPORT \
       DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=2 \
       $PYTHON -c "import geomx_tpu" > /tmp/hips_sched_$PPORT.log 2>&1 &
-    if [ "$PPORT" = "$APORT" ] && [ -n "${CHAOS_PLAN_SERVER_A:-}" ]; then
-      # chaos matrix server-kill case: party A's server (and ONLY it)
-      # runs under its own fault plan — a node/tier match alone cannot
-      # single it out (every party's server is local id 8)
-      env $(echo $GLOBALS) $NH_P DMLC_ROLE=server \
-        DMLC_PS_ROOT_URI=$PHOST DMLC_PS_ROOT_PORT=$PPORT \
-        DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=2 \
-        PS_FAULT_PLAN="$CHAOS_PLAN_SERVER_A" \
-        $PYTHON -c "import geomx_tpu" > /tmp/hips_server_$PPORT.log 2>&1 &
-    else
-      env $(echo $GLOBALS) $NH_P DMLC_ROLE=server \
-        DMLC_PS_ROOT_URI=$PHOST DMLC_PS_ROOT_PORT=$PPORT \
-        DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=2 \
-        $PYTHON -c "import geomx_tpu" > /tmp/hips_server_$PPORT.log 2>&1 &
-    fi
+    launch_hips_party_server "$PPORT" "$PHOST" "$NH_P" 2
     for w in 0 1; do
       if [ "$PPORT" = "$BPORT" ] && [ "$w" = "1" ]; then
         # last worker runs in the foreground (reference pattern)
@@ -89,5 +94,62 @@ launch_hips() {
       fi
       slice=$((slice+1))
     done
+  done
+}
+
+# mesh-party topology (docs/mesh-party.md, scripts/run_mesh_hips.sh):
+# 9 processes, 2 data parties, each a MESH_SIZE-device GSPMD mesh with
+# ONE van worker — intra-party aggregation is a device collective, so
+# DMLC_NUM_ALL_WORKER=2 (= parties): the global tier sums one
+# party-aggregate per party, not one gradient per member.
+# Honors CHAOS_PLAN_SERVER_A like launch_hips (chaos matrix
+# dist_sync_mesh case: kill party A's server, NOT party B's or the
+# global server's local role — all are local id 8).
+launch_mesh_hips() {
+  local script="$1"; shift
+  local extra="$@"
+  export GEOMX_PARTY_MESH=1
+  export GEOMX_PARTY_MESH_SIZE=${MESH_SIZE:-2}
+  # CPU demo stand-in for per-DC chips: give each worker process enough
+  # virtual devices for its party mesh (a real deployment drops this
+  # and uses the chips jax.devices() reports)
+  export XLA_FLAGS="${XLA_FLAGS:+$XLA_FLAGS }--xla_force_host_platform_device_count=$GEOMX_PARTY_MESH_SIZE"
+
+  # central party -----------------------------------------------------
+  env $(echo $GLOBALS) $NH_CENTRAL DMLC_ROLE_GLOBAL=global_scheduler \
+    $PYTHON -c "import geomx_tpu" > /tmp/hips_mesh_gsched.log 2>&1 &
+  env $NH_CENTRAL DMLC_ROLE=scheduler DMLC_PS_ROOT_URI=$HOST_CENTRAL DMLC_PS_ROOT_PORT=$CPORT \
+    DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=1 \
+    $PYTHON -c "import geomx_tpu" > /tmp/hips_mesh_csched.log 2>&1 &
+  env $(echo $GLOBALS) $NH_CENTRAL DMLC_ROLE_GLOBAL=global_server DMLC_ROLE=server \
+    DMLC_PS_ROOT_URI=$HOST_CENTRAL DMLC_PS_ROOT_PORT=$CPORT \
+    DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=1 DMLC_ENABLE_CENTRAL_WORKER=0 \
+    DMLC_NUM_ALL_WORKER=2 \
+    $PYTHON -c "import geomx_tpu" > /tmp/hips_mesh_gserver.log 2>&1 &
+  env $NH_CENTRAL DMLC_ROLE=worker DMLC_ROLE_MASTER_WORKER=1 \
+    DMLC_PS_ROOT_URI=$HOST_CENTRAL DMLC_PS_ROOT_PORT=$CPORT \
+    DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=1 DMLC_NUM_ALL_WORKER=2 \
+    $PYTHON $script $extra > /tmp/hips_mesh_master.log 2>&1 &
+
+  # data parties (one mesh worker each) -------------------------------
+  local slice=0
+  local PHOST NH_P
+  for PPORT in $APORT $BPORT; do
+    if [ "$PPORT" = "$APORT" ]; then PHOST=$HOST_A; NH_P=$NH_A; else PHOST=$HOST_B; NH_P=$NH_B; fi
+    env $NH_P DMLC_ROLE=scheduler DMLC_PS_ROOT_URI=$PHOST DMLC_PS_ROOT_PORT=$PPORT \
+      DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=1 \
+      $PYTHON -c "import geomx_tpu" > /tmp/hips_mesh_sched_$PPORT.log 2>&1 &
+    launch_hips_party_server "$PPORT" "$PHOST" "$NH_P" 1
+    if [ "$PPORT" = "$BPORT" ]; then
+      # last worker runs in the foreground (reference pattern)
+      env $NH_P DMLC_ROLE=worker DMLC_PS_ROOT_URI=$PHOST DMLC_PS_ROOT_PORT=$PPORT \
+        DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=1 DMLC_NUM_ALL_WORKER=2 \
+        $PYTHON -u $script --data-slice-idx $slice $extra
+    else
+      env $NH_P DMLC_ROLE=worker DMLC_PS_ROOT_URI=$PHOST DMLC_PS_ROOT_PORT=$PPORT \
+        DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=1 DMLC_NUM_ALL_WORKER=2 \
+        $PYTHON $script --data-slice-idx $slice $extra > /tmp/hips_mesh_w$slice.log 2>&1 &
+    fi
+    slice=$((slice+1))
   done
 }

@@ -13,6 +13,9 @@
 #   overlap     pipelined round under drops + reordering + duplicates
 #   quant-wire  2-bit quantized combined wire (error-feedback residuals
 #               on every leg) under drops + duplicates; sanitizer on
+#   dist-sync-mesh  mesh-party tier: int8 quantized ring intra-party +
+#               2-bit quantized van; party A's server killed mid-run,
+#               ring residuals must reset and the sanitizer stay silent
 #   worker-kill both data parties' worker 0 crashes at round 3; elastic
 #               membership resizes the round to the survivors
 #   server-kill party A's server crashes mid-round; survivors keep
@@ -125,6 +128,61 @@ unset GEOMX_WIRE_CODEC GEOMX_OVERLAP P3_SLICE_BYTES GEOMX_WIRE_SANITIZER
 if grep -l "WIRE-SANITIZER VIOLATION" /tmp/hips_*.log 2>/dev/null; then
   echo "=== chaos[quant-wire] FAILED: wire-sanitizer violations (see logs above) ==="
   collect_artifacts quant-wire-sanitizer "$LAST_FDIR" "$LAST_TDIR"
+  FAILED=1
+fi
+
+# quantized mesh + quantized van under a remote-server kill
+# (dist_sync_mesh): 2 parties x 2-virtual-device meshes, intra-party
+# gradients ride the int8 block-scaled ppermute ring
+# (GEOMX_MESH_CODEC), the van carries the 2-bit combined wire, and
+# party A's server crashes mid-run; a respawned server restores the
+# snapshot. The abort path must zero every ring error-feedback
+# residual stream (reset_mesh_residuals) before the retried round —
+# stale error replaying into the ring would corrupt the feedback
+# loop — and the wire sanitizer must stay silent through kill +
+# recovery on every node of the mesh topology.
+echo "=== chaos[dist-sync-mesh] seed=$SEED ==="
+LAST_FDIR=$(mktemp -d) LAST_TDIR=$(mktemp -d)
+CASE_DIRS+=("$LAST_FDIR" "$LAST_TDIR")
+rm -f /tmp/hips_mesh_*.log /tmp/hips_server_1019[23].log
+(
+  export PS_SEED=$SEED
+  export PS_RESEND=1 PS_RESEND_TIMEOUT=500 PS_RESEND_DEADLINE=120
+  export PS_HEARTBEAT_INTERVAL=1 PS_HEARTBEAT_TIMEOUT=3
+  export GEOMX_FLIGHTREC_DIR=$LAST_FDIR
+  export GEOMX_TELEMETRY=1 GEOMX_TELEMETRY_DIR=$LAST_TDIR
+  export PS_SNAPSHOT_DIR=$(mktemp -d) PS_SNAPSHOT_INTERVAL=1
+  export GEOMX_MESH_CODEC=int8 GEOMX_WIRE_CODEC=2bit
+  export GEOMX_OVERLAP=1 P3_SLICE_BYTES=131072 GEOMX_WIRE_SANITIZER=1
+  # scoped via hips_env.sh so ONLY party A's server runs this plan
+  # (see the server-kill case below); at=60 recv frames lands a few
+  # training rounds in — past init, while the ring residuals are warm
+  export CHAOS_PLAN_SERVER_A='[{"type": "crash", "node": 8, "at": 60, "on": "recv", "tier": "local"}]'
+  export GPORT=10190 CPORT=10191 APORT=10192 BPORT=10193
+  source ./hips_env.sh
+  # replacement party-A server: registers after the crash has been
+  # declared (mesh workers boot jax, so rounds — and the crash frame —
+  # land later than in the host-only topologies)
+  ( sleep 30
+    env $(echo $GLOBALS) DMLC_ROLE=server \
+      DMLC_PS_ROOT_URI=$HOST_A DMLC_PS_ROOT_PORT=$APORT \
+      DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=1 \
+      $PYTHON -c "import geomx_tpu" > /tmp/hips_mesh_server_A_respawn.log 2>&1
+  ) &
+  launch_mesh_hips "$REPO_DIR/examples/cnn.py" --cpu "$@" || exit 1
+  wait
+)
+if [ $? -eq 0 ]; then
+  echo "=== chaos[dist-sync-mesh] OK ==="
+else
+  echo "=== chaos[dist-sync-mesh] FAILED (re-run with PS_SEED=$SEED to reproduce) ==="
+  collect_artifacts dist-sync-mesh "$LAST_FDIR" "$LAST_TDIR"
+  FAILED=1
+fi
+if grep -l "WIRE-SANITIZER VIOLATION" /tmp/hips_mesh_*.log \
+     /tmp/hips_server_1019[23].log 2>/dev/null; then
+  echo "=== chaos[dist-sync-mesh] FAILED: wire-sanitizer violations (see logs above) ==="
+  collect_artifacts dist-sync-mesh-sanitizer "$LAST_FDIR" "$LAST_TDIR"
   FAILED=1
 fi
 

@@ -9,60 +9,17 @@
 #                  the party's only van speaker
 # DMLC_NUM_ALL_WORKER=2 (= number of parties): the global tier sums one
 # party-aggregate per party, not one gradient per member.
+#
+# GEOMX_MESH_CODEC=int8|2bit|fp16 additionally routes the intra-party
+# gradient all-reduce through the quantized ppermute ring (EQuARX;
+# docs/mesh-party.md "Quantized mesh collectives"). Default "none"
+# keeps the fused psum byte-for-byte.
+#
+# The topology itself lives in hips_env.sh (launch_mesh_hips) so the
+# chaos matrix can run the same wiring under fault plans.
 cd "$(dirname "$0")"
 
-REPO_DIR="$(cd .. && pwd)"
-export PYTHONPATH="$REPO_DIR${PYTHONPATH:+:$PYTHONPATH}"
 GPORT=${GPORT:-9092}; CPORT=${CPORT:-9093}; APORT=${APORT:-9094}; BPORT=${BPORT:-9095}
-PYTHON=${PYTHON:-python}
 MESH_SIZE=${MESH_SIZE:-2}
-
-# the mesh tier (see docs/env-var-summary.md "Mesh-party tier"):
-export GEOMX_PARTY_MESH=1
-export GEOMX_PARTY_MESH_SIZE=$MESH_SIZE
-# CPU demo stand-in for per-DC chips: give each worker process enough
-# virtual devices for its party mesh (a real deployment drops this and
-# uses the chips jax.devices() reports)
-export XLA_FLAGS="${XLA_FLAGS:+$XLA_FLAGS }--xla_force_host_platform_device_count=$MESH_SIZE"
-
-GLOBALS="DMLC_PS_GLOBAL_ROOT_URI=127.0.0.1 DMLC_PS_GLOBAL_ROOT_PORT=$GPORT \
-DMLC_NUM_GLOBAL_SERVER=1 DMLC_NUM_GLOBAL_WORKER=2"
-
-# central party ------------------------------------------------------
-env $(echo $GLOBALS) DMLC_ROLE_GLOBAL=global_scheduler \
-  $PYTHON -c "import geomx_tpu" > /tmp/hips_mesh_gsched.log 2>&1 &
-env DMLC_ROLE=scheduler DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$CPORT \
-  DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=1 \
-  $PYTHON -c "import geomx_tpu" > /tmp/hips_mesh_csched.log 2>&1 &
-env $(echo $GLOBALS) DMLC_ROLE_GLOBAL=global_server DMLC_ROLE=server \
-  DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$CPORT \
-  DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=1 DMLC_ENABLE_CENTRAL_WORKER=0 \
-  DMLC_NUM_ALL_WORKER=2 \
-  $PYTHON -c "import geomx_tpu" > /tmp/hips_mesh_gserver.log 2>&1 &
-env DMLC_ROLE=worker DMLC_ROLE_MASTER_WORKER=1 \
-  DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$CPORT \
-  DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=1 DMLC_NUM_ALL_WORKER=2 \
-  $PYTHON "$REPO_DIR/examples/cnn.py" --cpu "$@" > /tmp/hips_mesh_master.log 2>&1 &
-
-# data parties (one mesh worker each) --------------------------------
-slice=0
-for PPORT in $APORT $BPORT; do
-  env DMLC_ROLE=scheduler DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$PPORT \
-    DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=1 \
-    $PYTHON -c "import geomx_tpu" > /tmp/hips_mesh_sched_$PPORT.log 2>&1 &
-  env $(echo $GLOBALS) DMLC_ROLE=server \
-    DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$PPORT \
-    DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=1 \
-    $PYTHON -c "import geomx_tpu" > /tmp/hips_mesh_server_$PPORT.log 2>&1 &
-  if [ "$PPORT" = "$BPORT" ]; then
-    # last worker runs in the foreground (reference pattern)
-    env DMLC_ROLE=worker DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$PPORT \
-      DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=1 DMLC_NUM_ALL_WORKER=2 \
-      $PYTHON -u "$REPO_DIR/examples/cnn.py" --cpu --data-slice-idx $slice "$@"
-  else
-    env DMLC_ROLE=worker DMLC_PS_ROOT_URI=127.0.0.1 DMLC_PS_ROOT_PORT=$PPORT \
-      DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=1 DMLC_NUM_ALL_WORKER=2 \
-      $PYTHON "$REPO_DIR/examples/cnn.py" --cpu --data-slice-idx $slice "$@" > /tmp/hips_mesh_w$slice.log 2>&1 &
-  fi
-  slice=$((slice+1))
-done
+source ./hips_env.sh
+launch_mesh_hips "$REPO_DIR/examples/cnn.py" --cpu "$@"

@@ -1,0 +1,58 @@
+"""GX-J105 fixture: host transfers inside mesh codec classes.
+
+``PartyRingReducer`` violates the rule three ways (direct, transitive,
+and via ``.addressable_data``); ``CleanRingReducer`` shows the guard
+shapes that must stay clean; ``WireCodec`` proves the rule does NOT
+extend to the van wire codec, whose host arrays are the product.
+"""
+
+import numpy as np
+
+import jax
+
+
+class PartyRingReducer:
+    def reduce(self, x_stacked):
+        # VIOLATION: every mesh rank drags the reduced vector to host
+        return np.asarray(self._fn(x_stacked))
+
+    def quantize_hop(self, partial):
+        # VIOLATION (transitive): reached from a codec-shaped method
+        return self._drain(partial)
+
+    def _drain(self, partial):
+        return jax.device_get(partial)
+
+    def reset(self):
+        # VIOLATION: residual stream materialized on every rank
+        self._res = np.array(self._res.addressable_data(0))
+
+    def wire_bytes(self):
+        # not a codec-shaped method: never scanned
+        return np.asarray([0.0]).nbytes
+
+
+class CleanRingReducer:
+    def __init__(self):
+        self.is_global_worker = True
+
+    def reduce(self, x_stacked):
+        if self.is_global_worker:
+            return np.asarray(self._fn(x_stacked))    # guarded: clean
+        return self._fn(x_stacked)
+
+    def decode_probe(self, wire):
+        if not self.is_global_worker:
+            raise RuntimeError("probe is global-worker only")
+        return np.asarray(wire)                       # fenced: clean
+
+    def zero_residual(self, n):
+        # fresh host zeros are a constructor, not a device transfer
+        return np.zeros((n,), np.float32)
+
+
+class WireCodec:
+    def encode(self, tag, arr):
+        # same body as the violation above, but this is the VAN wire
+        # codec — host arrays are its product, out of the rule's scope
+        return np.asarray(arr, np.float32).ravel()

@@ -140,6 +140,27 @@ def test_mesh_host_transfer_fires():
     assert all(h.severity == "error" for h in hits)
 
 
+def test_mesh_codec_host_transfer_fires():
+    """GX-J105: unguarded host transfers inside codec-shaped methods of
+    Ring-named classes fire — directly, transitively, and for
+    .addressable_data — while guarded/fenced forms, host-zero
+    constructors, non-codec methods, and the van WireCodec (whose host
+    arrays are the product) stay clean."""
+    sources = load_sources([FIXTURES / "codec_bad.py"], FIXTURES)
+    hits = _by_rule(run_traced(sources), "GX-J105")
+    syms = {h.symbol for h in hits}
+    assert "PartyRingReducer.reduce" in syms
+    assert "PartyRingReducer.reset" in syms
+    # transitive: quantize_hop -> _drain -> jax.device_get
+    assert any(h.symbol == "PartyRingReducer._drain"
+               and "jax.device_get" in h.detail for h in hits)
+    # guarded / fenced / out-of-scope symbols never fire
+    assert all(not h.symbol.startswith("CleanRingReducer") for h in hits)
+    assert all(not h.symbol.startswith("WireCodec") for h in hits)
+    assert all(h.symbol != "PartyRingReducer.wire_bytes" for h in hits)
+    assert all(h.severity == "error" for h in hits)
+
+
 # ---------------------------------------------------------------------------
 # config-drift pass (GX-C201..C204)
 # ---------------------------------------------------------------------------

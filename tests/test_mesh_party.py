@@ -310,15 +310,21 @@ def test_abort_pending_unblocks_joiners_immediately():
 def test_fail_fast_pending_aborts_watched_futures():
     """The mesh store's round_abort_hook fans the inner store's round
     death out to every live future it issued (and only live ones — the
-    WeakSet drops collected futures)."""
+    WeakSet drops collected futures) and re-seeds the ring residual
+    streams so stale quantization error never replays into the retry."""
     store = object.__new__(KVStorePartyMesh)
     store._live_futs = weakref.WeakSet()
+    store._reducers = {}
+    store._residual_reset_hooks = []
+    resets = []
+    store.register_residual_reset_hook(lambda: resets.append(1))
     fut = store._watch(RoundFuture([0, 1]))
     gone = store._watch(RoundFuture([7]))
     del gone    # collected -> must not be touched (nor crash the hook)
     store._fail_fast_pending("server 9 declared dead")
     with pytest.raises(RoundAborted):
         fut.wait(timeout=1.0)
+    assert resets == [1]
 
 
 def test_ring_bytes_model():

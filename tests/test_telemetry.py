@@ -184,6 +184,7 @@ def test_mesh_store_count_collective_counter_family():
     telemetry.enable(True)
     store = object.__new__(KVStorePartyMesh)
     store.party_size = 4
+    store.mesh_codec = "none"
     KVStorePartyMesh.count_collective(store, 1000)
     snap = telemetry.snapshot()
     assert telemetry.mesh_bytes(snap) == 6000     # 2*(4-1)*1000
@@ -191,6 +192,19 @@ def test_mesh_store_count_collective_counter_family():
     msgs = [v for k, v in snap["counters"].items()
             if k.startswith("mesh.messages{")]
     assert msgs == [1]
+    # quantized codec: bytes follow the ring wire model under its own
+    # codec= label, still structurally outside the WAN bill
+    store.mesh_codec = "int8"
+    store.mesh_block = 256
+    KVStorePartyMesh.count_collective(store, 1000, op="ring")
+    snap = telemetry.snapshot()
+    from geomx_tpu.parallel.quant_collectives import ring_wire_bytes
+
+    assert telemetry.mesh_bytes(snap) == 6000 + ring_wire_bytes(
+        "int8", 250, 4, 256)
+    assert telemetry.wan_bytes(snap) == 0
+    assert any("codec=int8" in k and "op=ring" in k
+               for k in snap["counters"] if k.startswith("mesh.bytes{"))
 
 
 # ---------------------------------------------------------------------------

@@ -25,6 +25,18 @@ GX-J104 (error)   host transfer on a mesh rank's round path: round-shaped
                   (kvstore.mesh_party) only the party's global worker may
                   materialize host arrays; an unguarded transfer makes
                   EVERY mesh rank fetch device data it must never touch.
+GX-J105 (error)   host transfer inside a mesh codec: codec-shaped methods
+                  (name contains ``reduce``/``quant``/``encode``/
+                  ``decode``/``hop``/``reset``/``zero``/``residual``) of
+                  Ring/MeshCodec-named classes — closed over same-module
+                  calls — calling the same host-transfer set outside an
+                  ``is_global_worker`` guard. The quantized ring
+                  (parallel.quant_collectives) runs on EVERY rank of the
+                  party and its residual streams are device-resident by
+                  design; a host materialization there stalls all ranks
+                  every round. NOT the van wire codec (compression.device
+                  ``WireCodec``): host arrays are that codec's product,
+                  and only the global worker drives it.
 
 Reachability: seeds are functions decorated with (or wrapped by a call
 to) ``jax.jit``/``jit``/``pjit``/``jax.shard_map``/``shard_map`` —
@@ -55,6 +67,9 @@ _HOST_SYNC_METHODS = (".item", ".tolist", ".numpy", ".block_until_ready")
 _SCALAR_CASTS = {"float", "int", "bool", "complex"}
 _STEP_NAME_RE = re.compile(r"step|update", re.IGNORECASE)
 _MESH_ROUND_RE = re.compile(r"step|push|pull|round", re.IGNORECASE)
+_RING_CLS_RE = re.compile(r"Ring|MeshCodec|MeshQuant")
+_RING_CODEC_RE = re.compile(
+    r"reduce|quant|encode|decode|hop|reset|zero|residual", re.IGNORECASE)
 _HOST_XFER_METHODS = (".addressable_data",)
 
 
@@ -371,4 +386,36 @@ def run_traced(sources: Sequence[SourceFile]) -> List[Finding]:
                              f"{fi.qualname} materializes device data on "
                              f"the host; only the party's global worker "
                              f"may — guard with is_global_worker")))
+
+        # ---- GX-J105 host transfers inside a mesh codec --------------
+        ring_nodes: Set[ast.AST] = set()
+        rfrontier: List[ast.AST] = []
+        for fi in fns:
+            if fi.cls and _RING_CLS_RE.search(fi.cls) \
+                    and _RING_CODEC_RE.search(fi.node.name):
+                ring_nodes.add(fi.node)
+                rfrontier.append(fi.node)
+        while rfrontier:
+            fn = rfrontier.pop()
+            fi = node_to_info[fn]
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call):
+                    callee = resolve(sub.func, fi)
+                    if callee is not None and callee.node not in ring_nodes:
+                        ring_nodes.add(callee.node)
+                        rfrontier.append(callee.node)
+        # a node already on a GX-J104 round path reports there, not twice
+        for fn in sorted(ring_nodes - mesh_nodes, key=lambda n: n.lineno):
+            fi = node_to_info[fn]
+            hits = []
+            _scan_mesh_body(list(fn.body), False, hits)
+            for call, nm in hits:
+                findings.append(Finding(
+                    "GX-J105", SEV_ERROR, src.rel, call.lineno,
+                    symbol=fi.qualname, detail=f"{nm}:{call.lineno}",
+                    message=(f"{nm}() inside mesh codec {fi.qualname} "
+                             f"drags device-resident ring state to the "
+                             f"host on every rank, every round; keep the "
+                             f"codec on device or guard the transfer "
+                             f"with is_global_worker")))
     return findings
