@@ -1557,7 +1557,21 @@ def main(argv=None):
     ap.add_argument("--budget", type=float, default=3300.0,
                     help="overall wall budget (s); phases that don't "
                     "fit are marked errored, the JSON still emits")
+    ap.add_argument("--shape", default="",
+                    help="ShapePlan JSON path or inline JSON "
+                    "(ps/shaping.py): every PS phase runs its wire on "
+                    "the emulated WAN. Exported as GEOMX_SHAPE_PLAN so "
+                    "each phase subprocess inherits it.")
+    ap.add_argument("--shape-seed", type=int, default=-1,
+                    help="GEOMX_SHAPE_SEED for --shape (default: plan "
+                    "seed, else PS_SEED)")
     args = ap.parse_args(argv)
+    if args.shape:
+        plan = args.shape.strip()
+        os.environ["GEOMX_SHAPE_PLAN"] = plan \
+            if plan.startswith(("{", "[", "@")) else "@" + plan
+        if args.shape_seed >= 0:
+            os.environ["GEOMX_SHAPE_SEED"] = str(args.shape_seed)
     if args.phase:
         _phase_child(args.phase)
         return

@@ -167,6 +167,12 @@ class Config:
     ps_seed: int = -1                   # PS_SEED
     # chaos plan: inline JSON, or "@/path/to/plan.json"
     fault_plan: str = ""                # PS_FAULT_PLAN
+    # per-link RTT/bandwidth shaping topology (ps/shaping.py): inline
+    # JSON or "@/path/to/plan.json"; canonical plans in scripts/shapes/
+    shape_plan: str = ""                # GEOMX_SHAPE_PLAN
+    # jitter-stream seed for the shaper; -1 defers to the plan's
+    # embedded "seed", then PS_SEED (same precedence as fault plans)
+    shape_seed: int = -1                # GEOMX_SHAPE_SEED
     # overall per-request retransmit deadline (seconds); a request
     # unACKed past this raises TimeoutError at the issuing customer.
     # 0 = no deadline (retry-count cap only, the old behavior)
@@ -220,7 +226,9 @@ class Config:
     # set is greedily grouped in layer order into ~this many bytes per
     # chunk — and dense keys above it are sliced at _shards granularity —
     # each chunk one message per server, flowing independently at
-    # descending priority. 0 = one chunk (the round-5 batched wire).
+    # descending priority. 0 = one chunk (the round-5 batched wire);
+    # -1 = auto-size to the shaped topology's worst-link BDP
+    # (frontier.auto_slice_bytes over GEOMX_SHAPE_PLAN).
     p3_slice_bytes: int = 0             # P3_SLICE_BYTES
     # trainer-side overlap switch: per-chunk dispatch/apply in
     # DeviceResidentTrainer and the deferred round barrier in Trainer
@@ -348,6 +356,8 @@ def load() -> Config:
         drop_rate=env_float("PS_DROP_MSG", 0.0),
         ps_seed=env_int("PS_SEED", -1),
         fault_plan=env_str("PS_FAULT_PLAN"),
+        shape_plan=env_str("GEOMX_SHAPE_PLAN"),
+        shape_seed=env_int("GEOMX_SHAPE_SEED", -1),
         resend_deadline_s=env_float("PS_RESEND_DEADLINE", 0.0),
         resend_backoff_max_s=env_float("PS_RESEND_BACKOFF_MAX", 30.0),
         resend_jitter=env_float("PS_RESEND_JITTER", 0.1),

@@ -28,6 +28,7 @@ per-device arrays to ``push`` and they are summed on host as a fallback.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import pickle
 import threading
@@ -41,7 +42,9 @@ from geomx_tpu import telemetry
 from geomx_tpu.compression.device import WireCodec, decode_wire
 from geomx_tpu.kvstore import sharding
 from geomx_tpu.kvstore.base import Command, DATA_INIT, KVStore, _sum_values
-from geomx_tpu.kvstore.frontier import RoundFuture, give_up_exc, plan_chunks
+from geomx_tpu.kvstore.frontier import (RoundFuture, give_up_exc,
+                                        plan_chunks,
+                                        slice_bytes_from_shape)
 from geomx_tpu.ps import base as psbase
 from geomx_tpu.ps.kv_app import KVPairs, KVWorker
 from geomx_tpu.ps.message import Role
@@ -93,6 +96,13 @@ class KVStoreDist(KVStore):
         super().__init__()
         self.cfg = cfg or cfg_mod.load()
         c = self.cfg
+        if c.p3_slice_bytes < 0:
+            # P3_SLICE_BYTES=-1: auto-size the chunk budget to the
+            # shaped topology's worst-link BDP. Must resolve HERE —
+            # _shards fixes shard boundaries at init from this value,
+            # so it cannot float per call.
+            c = self.cfg = dataclasses.replace(
+                c, p3_slice_bytes=slice_bytes_from_shape(c))
         self._sync_global = sync_global
         self.po = Postoffice(
             my_role=Role.WORKER, is_global=False,
@@ -778,7 +788,16 @@ class KVStoreDist(KVStore):
             for k in completed:
                 fut.complete_key(k)
 
-        for mid, cid, srank, kvs, _mks, prio in msgs:
+        # dispatch largest message first: the biggest chunks are the
+        # lone shards of sliced keys, and a sliced key's global round
+        # releases only when EVERY shard from every party lands — on a
+        # bandwidth-shaped WAN, sending them first starts the response
+        # stream back while the small chunks are still serializing
+        # upstream (loopback is order-indifferent). Bookkeeping is
+        # positional over ``msgs``, so only the send order changes.
+        for mid, cid, srank, kvs, _mks, prio in sorted(
+                msgs, key=lambda m: -sum(
+                    np.asarray(v).nbytes for v in m[3].vals)):
             with profiler.chunk_scope("send", cid, server=srank,
                                       keys=len(kvs.keys)):
                 self.kvw.push(kvs, srank, priority=prio, pull=True,

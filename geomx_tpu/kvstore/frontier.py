@@ -35,8 +35,9 @@ from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
 from geomx_tpu import telemetry
 
-__all__ = ["give_up_exc", "Chunk", "plan_chunks", "RoundFuture",
-           "RoundAborted", "WorkerLostError"]
+__all__ = ["give_up_exc", "Chunk", "plan_chunks", "auto_slice_bytes",
+           "slice_bytes_from_shape", "RoundFuture", "RoundAborted",
+           "WorkerLostError"]
 
 
 class RoundAborted(RuntimeError):
@@ -89,6 +90,49 @@ class Chunk:
     def __repr__(self) -> str:  # debugging/test aid
         return f"Chunk(cid={self.cid}, items={self.items}, " \
                f"priority={self.priority}, codec={self.codec!r})"
+
+
+def auto_slice_bytes(rtt_ms: float, bw_mbps: float,
+                     min_bytes: int = 65536,
+                     max_bytes: int = 4 << 20) -> int:
+    """Chunk budget from the link's bandwidth-delay product.
+
+    On a shaped WAN the sweet spot for ``P3_SLICE_BYTES`` is roughly
+    one BDP per chunk: smaller and the per-message floor dominates
+    (the loopback <1x regime, PERF.md "pipelined round"); larger and
+    there are too few chunks in flight to hide the RTT. Sized from
+    the topology's worst (highest-BDP) shaped link —
+    ``ShapePlan.worst_link`` — via ``P3_SLICE_BYTES=-1``.
+
+    ``bw_mbps == 0`` (latency-only link) assumes a fat pipe: the
+    budget clamps to ``max_bytes`` so chunking still happens and the
+    RTT can be overlapped."""
+    rtt_s = max(rtt_ms, 0.0) / 1e3
+    if rtt_s == 0.0:
+        return 0  # unshaped: keep the single-chunk round-5 wire
+    if bw_mbps <= 0:
+        return max_bytes
+    bdp = rtt_s * bw_mbps * 1e6 / 8.0
+    return int(min(max(bdp, min_bytes), max_bytes))
+
+
+def slice_bytes_from_shape(cfg) -> int:
+    """Resolve ``P3_SLICE_BYTES=-1`` (auto) against GEOMX_SHAPE_PLAN:
+    chunk at the worst shaped global link's BDP
+    (:func:`auto_slice_bytes` over ``ShapePlan.worst_link``), or fall
+    back to the single-chunk wire when nothing is shaped. Shared by
+    the worker store and the server (the server FSA sub-splits its
+    canonical ranges at the same budget), so both sides of the wire
+    resolve one auto value from one plan."""
+    from geomx_tpu.ps import shaping as shaping_mod
+
+    plan = shaping_mod.plan_from_config(cfg)
+    if plan is None:
+        return 0
+    worst = plan.worst_link(is_global=True)
+    if worst is None:
+        return 0
+    return auto_slice_bytes(*worst)
 
 
 def plan_chunks(items: Sequence, sizes_bytes: Sequence[int],

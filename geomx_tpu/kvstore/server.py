@@ -48,6 +48,7 @@ aligned wire-key ranges and supports only matching layouts).
 
 from __future__ import annotations
 
+import dataclasses
 import io
 import json
 import logging
@@ -67,6 +68,7 @@ from geomx_tpu.compression import make_compressor
 from geomx_tpu.compression.device import WireCodec
 from geomx_tpu.kvstore import sharding
 from geomx_tpu.kvstore.base import Command, DATA_INIT
+from geomx_tpu.kvstore.frontier import slice_bytes_from_shape
 from geomx_tpu.ps import base as psbase
 from geomx_tpu.ps.kv_app import KVPairs, KVServer, KVWorker, ReqMeta
 from geomx_tpu.ps.message import Role
@@ -247,6 +249,13 @@ class KVStoreDistServer:
     def __init__(self, cfg: Optional[cfg_mod.Config] = None):
         self.cfg = cfg or cfg_mod.load()
         c = self.cfg
+        if c.p3_slice_bytes < 0:
+            # P3_SLICE_BYTES=-1 (auto): resolve against the shape plan
+            # exactly like KVStoreDist does — the FSA sub-splits its
+            # canonical ranges at this budget, so both wire ends must
+            # land on the same value from the same plan
+            c = self.cfg = dataclasses.replace(
+                c, p3_slice_bytes=slice_bytes_from_shape(c))
         self.is_global_server = c.is_global_server
         # party servers forward to the global tier; the global server IS it
         self.has_global_tier = c.has_global_tier and not self.is_global_server
@@ -275,6 +284,13 @@ class KVStoreDistServer:
         kernels_native.lib()
         self._states: Dict[Tuple[int, int], _KeyState] = {}
         self._key_total: Dict[int, int] = {}
+        # global-store FSA granularity in ELEMENTS: >0 sub-splits the
+        # canonical ranges at the P3 chunk budget so a sliced key's
+        # round releases shard by shard (each fine state counts its own
+        # parties' pushes) instead of holding every response until the
+        # whole key lands. Finalized in start() — TSEngine offers
+        # models per canonical shard, so overlays keep coarse states.
+        self._fsa_slice_elems = 0
         self.sync_mode = True
         # False by default (reference: kvstore_dist_server.h:2019); set by the
         # master worker's kSyncGlobalMode command for "dist_sync" only —
@@ -440,6 +456,14 @@ class KVStoreDistServer:
             # resumed training must observe pre-crash weights, not re-init
             self.replication.restore()
         self.replication.start()
+        # fine-grained FSA states: only with a P3 chunk budget and no
+        # TSEngine (overlays offer models per canonical shard — fine
+        # states would fragment the offers). Fixed here, before _ready
+        # releases the first request, because the per-(key, offset)
+        # states pin to whatever granularity the first contact sees.
+        if self.cfg.p3_slice_bytes > 0 and self.ts_global is None \
+                and self.ts_local is None:
+            self._fsa_slice_elems = max(1, self.cfg.p3_slice_bytes // 4)
         self._ready.set()
 
     def run(self) -> None:
@@ -783,15 +807,21 @@ class KVStoreDistServer:
 
     def _push_global_store(self, req, srv, key, off, val, total,
                            from_global_tier) -> List[Action]:
-        ranges = self._canonical_ranges(key, total)
-        acts: List[Action] = []
-        touched = False
-        for rng in ranges:
+        hits = []
+        for rng in self._canonical_ranges(key, total):
             lo = max(off, rng.offset)
             hi = min(off + val.size, rng.offset + rng.length)
-            if lo >= hi:
-                continue
-            touched = True
+            if lo < hi:
+                hits.append((rng, lo, hi))
+        if len(hits) > 1:
+            # one push entry spanning several fine FSA states (a
+            # whole-range init, or a peer chunking coarser than this
+            # server): each state acks once — possibly rounds apart —
+            # and the transport allows ONE response per request
+            srv = _BatchResponder(srv, len(hits))
+        acts: List[Action] = []
+        touched = bool(hits)
+        for rng, lo, hi in hits:
             sub = val[lo - off:hi - off]
             st = self._state(key, rng.offset)
             with st.lock:
@@ -1316,16 +1346,13 @@ class KVStoreDistServer:
             # coalesce (see _handle_data / _flush_forward_batch)
             ents.append((key, off, cycle))
             return
-        st = self._state(key, off)
-        with st.lock:
-            if st.cycle != cycle:
-                return
-            total = st.total
-            slices = self._global_slices(key, off, st.length, total)
-            st.fwd_acks_left = len(slices)
-            st.fwd_wire = {}
-        for g_rank, lo, hi in slices:
-            self._push_slice_global(key, off, cycle, g_rank, lo, hi, total)
+        # single-key forward: still a one-item "batch" so the pull-back
+        # rides the push ack (pull=True). The legacy per-slice path
+        # (_push_slice_global, plain push) costs a SECOND WAN round-trip
+        # for the explicit pull — on a shaped 50ms link that extra RTT
+        # made lone P3 shard chunks slower pipelined than serial. It
+        # remains the retry fallback for undeliverable batches.
+        self._flush_forward_batch([(key, off, cycle)])
 
     def _push_slice_global(self, key, off, cycle, g_rank, lo, hi,
                            total) -> None:
@@ -2072,10 +2099,23 @@ class KVStoreDistServer:
             return self._states.setdefault((key, offset), _KeyState(offset))
 
     def _canonical_ranges(self, key: int, total: int) -> List[sharding.Shard]:
-        """This global server's canonical shard(s) of ``key``."""
+        """This global server's canonical shard(s) of ``key``.
+
+        With a P3 chunk budget (and no TSEngine) the shards sub-split
+        at the budget so each slice runs its OWN FSA countdown: a
+        sliced key's round then releases shard by shard as the parties'
+        chunks land, instead of parking every combined push+pull
+        response until the key's last shard arrives — on a shaped WAN
+        that parking serialized a full extra bandwidth-delay product
+        into the pipelined round's tail. Peers addressing the coarse
+        range still work: a request overlapping several fine states is
+        fanned out and its acks merge through a _BatchResponder.
+        """
         po = self.po_global if self.po_global else self.po_local
         my_rank = po.my_rank
         n = po.num_servers
-        return [s for s in sharding.assign(key, total, n,
+        mine = [s for s in sharding.assign(key, total, n,
                                            self.cfg.bigarray_bound)
                 if s.server_rank == my_rank]
+        return sharding.split_slices(
+            mine, getattr(self, "_fsa_slice_elems", 0))

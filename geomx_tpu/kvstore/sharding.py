@@ -45,6 +45,30 @@ def assign(key: int, num_elems: int, num_servers: int, bigarray_bound: int) -> L
     return shards
 
 
+def split_slices(shards: List[Shard], slice_elems: int) -> List[Shard]:
+    """Cut shards into at-most-``slice_elems`` pieces, keeping placement.
+
+    Unlike :func:`assign_p3` (which re-derives placement with the slice
+    bound as the bigarray bound), this refines an EXISTING assignment:
+    server ranks and outer boundaries are untouched, so it is safe to
+    apply to one side of the wire only — a peer still addressing the
+    coarse ranges overlaps a contiguous run of the fine ones.
+    """
+    if slice_elems <= 0:
+        return shards
+    out: List[Shard] = []
+    for sh in shards:
+        if sh.length <= slice_elems:
+            out.append(sh)
+            continue
+        off, end = sh.offset, sh.offset + sh.length
+        while off < end:
+            ln = min(slice_elems, end - off)
+            out.append(Shard(sh.server_rank, off, ln, sh.total))
+            off += ln
+    return out
+
+
 def assign_p3(key: int, num_elems: int, num_servers: int,
               slice_bound: int) -> List[Shard]:
     """P3 slicing (reference: P3_EncodeDefaultKey, kvstore_dist.h:768-805).

@@ -106,6 +106,24 @@ class FaultRule:
         return self.tier == ("global" if is_global else "local")
 
 
+def deliver_later(van, delay_s: float, msg) -> None:
+    """Hold ``msg`` for ``delay_s`` then re-inject it through the van's
+    normal dispatch (``van._process``). Shared by the fault injector's
+    delay/dup rules and the link shaper (``ps/shaping.py``) so both
+    layers use one timer/delivery mechanism — a frame held by either
+    re-enters the SAME way and is never gated (or shaped) twice."""
+    def _deliver():
+        try:
+            if not van.stopped.is_set():
+                van._process(msg)
+        except Exception:  # noqa: BLE001 — held frames must not kill vans
+            log.exception("delayed re-injection failed")
+
+    t = threading.Timer(delay_s, _deliver)
+    t.daemon = True
+    t.start()
+
+
 class FaultPlan:
     """Immutable parsed plan; ``bind(van)`` yields a per-van injector."""
 
@@ -339,16 +357,7 @@ class FaultInjector:
 
     def _later(self, delay_s: float, msg) -> None:
         """Re-inject a frame through the van's normal dispatch."""
-        def deliver():
-            try:
-                if not self.van.stopped.is_set():
-                    self.van._process(msg)
-            except Exception:  # noqa: BLE001 — injector must not kill vans
-                log.exception("fault re-injection failed")
-
-        t = threading.Timer(delay_s, deliver)
-        t.daemon = True
-        t.start()
+        deliver_later(self.van, delay_s, msg)
 
     def _do_crash(self, idx: int, rule: FaultRule, src: int, dst: int,
                   seq: int) -> None:

@@ -18,6 +18,7 @@ from geomx_tpu import config as cfg_mod
 from geomx_tpu import telemetry
 from geomx_tpu.ps import base
 from geomx_tpu.ps import faults
+from geomx_tpu.ps import shaping
 from geomx_tpu.ps.customer import Customer
 from geomx_tpu.ps.message import Message, Role
 from geomx_tpu.ps.van import Van
@@ -62,6 +63,8 @@ class Postoffice:
             # PS_SEED / PS_FAULT_PLAN: deterministic fault injection
             seed=faults.van_seed(cfg, my_role, is_global),
             fault_plan=faults.plan_from_config(cfg),
+            # GEOMX_SHAPE_PLAN / GEOMX_SHAPE_SEED: per-link WAN shaping
+            shape_plan=shaping.plan_from_config(cfg),
             heartbeat_interval_s=cfg.heartbeat_interval_s,
             heartbeat_timeout_s=cfg.heartbeat_timeout_s,
             epoch_grace_s=cfg.epoch_grace_s,

@@ -49,6 +49,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from geomx_tpu import telemetry
 from geomx_tpu.ps import base
 from geomx_tpu.ps.kv_app import KVPairs
 from geomx_tpu.ps.message import Control, Message, Meta
@@ -313,8 +314,16 @@ class TSNode:
 
     def _hop_acked(self, dest: int, nbytes: int, t0: float) -> None:
         dt = max(time.monotonic() - t0, 1e-6)
+        mb_s = nbytes / dt / 1e6
+        # measured push->ack wall time: a shaped link's serialization +
+        # RTT lands here, so the scheduler's throughput matrix — and
+        # this observability gauge — reflect emulated WAN conditions
+        telemetry.gauge_set("link.goodput_mb_s", mb_s,
+                            src=self.po.van.my_id, dst=dest,
+                            tier="global" if self.po.van.is_global
+                            else "local")
         with self._lock:
-            self._reports.append([dest, nbytes / dt / 1e6])
+            self._reports.append([dest, mb_s])
 
     def _take_reports(self) -> List[List[float]]:
         with self._lock:

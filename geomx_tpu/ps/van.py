@@ -38,6 +38,7 @@ from geomx_tpu.ps import dgt as dgt_mod
 from geomx_tpu.ps import faults as faults_mod
 from geomx_tpu.ps import native as native_mod
 from geomx_tpu.ps import resender as resender_mod
+from geomx_tpu.ps import shaping as shaping_mod
 from geomx_tpu.ps.flightrec import FlightRecorder
 from geomx_tpu.ps.message import (Control, Message, Meta, Node, Role,
                                   read_message)
@@ -72,6 +73,7 @@ class Van:
         dgt: Optional[dict] = None,
         seed: Optional[int] = None,
         fault_plan: Optional["faults_mod.FaultPlan"] = None,
+        shape_plan: Optional["shaping_mod.ShapePlan"] = None,
         wire_sanitizer: bool = False,
         flightrec_size: int = 256,
         flightrec_dir: str = "",
@@ -107,6 +109,11 @@ class Van:
         # declarative chaos (PS_FAULT_PLAN): consulted by every inbound
         # dispatch before the legacy drop_rate check
         self._faults = fault_plan.bind(self) if fault_plan is not None \
+            else None
+        # per-link RTT/bandwidth emulation (GEOMX_SHAPE_PLAN): consulted
+        # by every inbound dispatch after the chaos layers — a frame a
+        # fault drops was never on the wire, so it is never shaped
+        self._shaper = shape_plan.bind(self) if shape_plan is not None \
             else None
         # fired (after stop()) when a FaultPlan crash rule kills this
         # van — the owner simulates full process death (e.g. a
@@ -238,6 +245,8 @@ class Van:
             self._resender.on_give_up = self._on_resend_give_up
         if self._faults is not None:
             self._faults.arm()
+        if self._shaper is not None:
+            self._shaper.arm()
         if self._native is not None:
             self._spawn(self._native_recv_loop, "van-nrecv")
         else:
@@ -356,7 +365,15 @@ class Van:
                          msg.meta.sender)
             return False
         if not msg.is_control:
+            # count on ACCEPTANCE, before any shaping hold — a held
+            # frame is on the (emulated) wire, so crash-at-message-N
+            # fault points land identically shaped or not
             self.num_data_recv += 1
+        if self._shaper is not None and not self._shaper.on_inbound(msg):
+            # accepted but held for its link delay; re-enters through
+            # _process (same path as fault-delayed frames), which
+            # bypasses this gate — never gated or shaped twice
+            return False
         return True
 
     def _crash_from_fault(self, reason: str) -> None:

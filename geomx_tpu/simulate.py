@@ -54,7 +54,8 @@ class InProcessHiPS:
                  hfa_k2: int = 1, enable_central_worker: bool = False,
                  bigarray_bound: int = 1_000_000,
                  party_mesh_size: int = 0,
-                 extra_cfg: Optional[dict] = None):
+                 extra_cfg: Optional[dict] = None,
+                 per_party_cfg: Optional[dict] = None):
         self.gport = free_port()
         self.cports = [free_port() for _ in range(num_parties + 1)]
         self.num_parties = num_parties
@@ -87,6 +88,12 @@ class InProcessHiPS:
         self.ecw = enable_central_worker
         self.sync_global = sync_global
         self.extra_cfg = dict(extra_cfg or {})
+        # per-party Config overrides (party index -> dict), layered on
+        # top of extra_cfg for that party's servers AND workers — the
+        # heterogeneous-WAN chaos cases give each party its own wire
+        # codec / fault plan while the shape plan stays cluster-wide
+        self.per_party_cfg = {int(k): dict(v)
+                              for k, v in (per_party_cfg or {}).items()}
         self.threads: List[threading.Thread] = []
         self.servers: List[KVStoreDistServer] = []
         self.workers: List[KVStoreDist] = []
@@ -95,7 +102,7 @@ class InProcessHiPS:
 
     # -- wiring ----------------------------------------------------------
 
-    def _common(self, **kw) -> Config:
+    def _common(self, party: Optional[int] = None, **kw) -> Config:
         base = dict(
             ps_global_root_uri="127.0.0.1", ps_global_root_port=self.gport,
             num_global_workers=self.ngw, num_global_servers=self.ngs,
@@ -106,6 +113,8 @@ class InProcessHiPS:
             bigarray_bound=self.bigarray_bound,
         )
         base.update(self.extra_cfg)
+        if party is not None:
+            base.update(self.per_party_cfg.get(party, {}))
         base.update(kw)
         return Config(**base)
 
@@ -174,7 +183,7 @@ class InProcessHiPS:
             self._spawn(self._run_sched, port, False, self.van_wpp, spp)
             for _ in range(spp):
                 cfg = self._common(
-                    role="server",
+                    party=p, role="server",
                     ps_root_uri="127.0.0.1", ps_root_port=port,
                     num_workers=self.van_wpp, num_servers=spp,
                 )
@@ -189,7 +198,7 @@ class InProcessHiPS:
                 from geomx_tpu.parallel.mesh import make_party_mesh
 
                 wcfg = self._common(
-                    role="worker", party_mesh=True,
+                    party=p, role="worker", party_mesh=True,
                     party_mesh_size=self.pms,
                     ps_root_uri="127.0.0.1", ps_root_port=port,
                     num_workers=1, num_servers=spp,
@@ -203,7 +212,7 @@ class InProcessHiPS:
                 continue
             for _ in range(self.wpp):
                 wcfg = self._common(
-                    role="worker",
+                    party=p, role="worker",
                     ps_root_uri="127.0.0.1", ps_root_port=port,
                     num_workers=self.wpp, num_servers=spp,
                 )
@@ -219,7 +228,9 @@ class InProcessHiPS:
         mbox: list = []
         self._spawn(lambda: mbox.append(
             KVStoreDist(sync_global=self.sync_global, cfg=mcfg)))
-        for _ in range(1200):
+        # startup budget scales with topology size: a 64-party cluster
+        # on few cores legitimately takes minutes to rendezvous
+        for _ in range(1200 + 100 * self.num_parties):
             if self.errors:
                 raise self.errors[0]
             if len(mbox) == 1 and all(len(b) == 1 for b in worker_boxes):

@@ -234,6 +234,45 @@ def wan_bytes_by_codec(snap: Optional[Dict[str, Any]] = None
     return out
 
 
+def _per_link(prefix: str, table: Dict[str, float]
+              ) -> Dict[Tuple[int, int], float]:
+    """Collapse ``name{...src=A,dst=B...}`` rows into ``{(A, B): v}``."""
+    out: Dict[Tuple[int, int], float] = {}
+    for key, v in table.items():
+        if not key.startswith(prefix + "{"):
+            continue
+        src = dst = None
+        inner = key[key.index("{") + 1:key.rindex("}")]
+        for part in inner.split(","):
+            if part.startswith("src="):
+                src = int(part[len("src="):])
+            elif part.startswith("dst="):
+                dst = int(part[len("dst="):])
+        if src is not None and dst is not None:
+            out[(src, dst)] = v
+    return out
+
+
+def link_goodput(snap: Optional[Dict[str, Any]] = None
+                 ) -> Dict[Tuple[int, int], float]:
+    """Observed per-link goodput (MB/s), keyed ``(src, dst)`` — the
+    TSEngine sender's push->ack measurement (``link.goodput_mb_s``
+    gauges). Under GEOMX_SHAPE_PLAN this reflects the emulated link,
+    which is exactly what lets the scheduler route around thin pipes."""
+    if snap is None:
+        snap = snapshot()
+    return _per_link("link.goodput_mb_s", snap.get("gauges", {}))
+
+
+def link_shaped_delay_ms(snap: Optional[Dict[str, Any]] = None
+                         ) -> Dict[Tuple[int, int], float]:
+    """Last emulated delivery delay (ms) the shaper imposed per link
+    (``link.shaped_delay_ms`` gauges, keyed ``(src, dst)``)."""
+    if snap is None:
+        snap = snapshot()
+    return _per_link("link.shaped_delay_ms", snap.get("gauges", {}))
+
+
 def mesh_bytes(snap: Optional[Dict[str, Any]] = None) -> float:
     """Total bytes moved by mesh-party device collectives in ``snap``
     (default: the live registry). These live under their own counter

@@ -16,6 +16,11 @@
 #   dist-sync-mesh  mesh-party tier: int8 quantized ring intra-party +
 #               2-bit quantized van; party A's server killed mid-run,
 #               ring residuals must reset and the sanitizer stay silent
+#   shaped-16p  16 in-process parties on the heterogeneous WAN plan
+#               (scripts/shapes/hetero16.json): thin-party stragglers,
+#               one flapping party server, asymmetric per-link 2-bit
+#               codecs on the thin legs; the wire sanitizer audits
+#               every van and any violation marker fails the case
 #   worker-kill both data parties' worker 0 crashes at round 3; elastic
 #               membership resizes the round to the survivors
 #   server-kill party A's server crashes mid-round; survivors keep
@@ -128,6 +133,23 @@ unset GEOMX_WIRE_CODEC GEOMX_OVERLAP P3_SLICE_BYTES GEOMX_WIRE_SANITIZER
 if grep -l "WIRE-SANITIZER VIOLATION" /tmp/hips_*.log 2>/dev/null; then
   echo "=== chaos[quant-wire] FAILED: wire-sanitizer violations (see logs above) ==="
   collect_artifacts quant-wire-sanitizer "$LAST_FDIR" "$LAST_TDIR"
+  FAILED=1
+fi
+
+# shaped 16-party chaos (in-process): the link-shaping layer
+# (ps/shaping.py) composed with stragglers, a flapping party server
+# and asymmetric per-link codecs, sanitizer on. tools/chaos_sim.py
+# scales the matrix past the 12-process ceiling — 16-64 parties run as
+# threads in ONE process — and exits non-zero on any sanitizer marker
+# or incomplete worker, so run_case's plumbing isn't needed here.
+echo "=== chaos[shaped-16p] seed=$SEED ==="
+if PS_SEED=$SEED JAX_PLATFORMS=cpu \
+     ${PYTHON:-python} "$(pwd)/../tools/chaos_sim.py" \
+     --parties 16 --seed "$SEED" \
+     --shape "$(pwd)/shapes/hetero16.json"; then
+  echo "=== chaos[shaped-16p] OK ==="
+else
+  echo "=== chaos[shaped-16p] FAILED (re-run with PS_SEED=$SEED to reproduce) ==="
   FAILED=1
 fi
 

@@ -169,6 +169,44 @@ def test_tsnode_drops_stale_relay_without_ack():
     assert node._slots == {}                            # ... untouched
 
 
+def test_scheduler_push_greedy_prefers_fat_links():
+    """Under a heterogeneous throughput matrix the greedy matchmaking
+    measurably prefers the fat link: with four askers pending and one
+    pair's measured throughput far above the rest, that pair is formed
+    (in the reported direction)."""
+    van = FakeVan()
+    sched = TSScheduler(van, num_workers=4, greed_rate=1.0)
+    w = [psbase.worker_rank_to_id(r) for r in range(4)]
+    # sender-side reports ride the asks: w0->w1 is the fat metro link,
+    # everything else measured thin
+    _ask(sched, Control.ASKPUSH, w[0], key=0, off=0, ver=1, nm=1, tgt=4,
+         rep=[[w[1], 500.0], [w[2], 2.0], [w[3], 2.0]])
+    _ask(sched, Control.ASKPUSH, w[1], key=0, off=0, ver=1, nm=1, tgt=4,
+         rep=[[w[0], 3.0], [w[2], 2.0]])
+    rep = _replies(van)
+    # two askers pending -> one pair; greedy must pick the fat direction
+    assert rep == [(w[0], {"kind": "push", "key": 0, "off": 0, "ver": 1,
+                           "dest": w[1]})]
+
+
+def test_scheduler_degraded_link_triggers_reroute():
+    """A link whose measured throughput collapses (EWMA decays on every
+    fresh report) stops being chosen: the scheduler re-routes the pair
+    through the now-fastest link."""
+    van = FakeVan()
+    sched = TSScheduler(van, num_workers=4, greed_rate=1.0)
+    w = [psbase.worker_rank_to_id(r) for r in range(4)]
+    sched._update_tput(w[0], w[1], 1000.0)   # initially fat
+    sched._update_tput(w[2], w[3], 100.0)    # steady mid link
+    assert sched._pick_pair({w[0], w[1], w[2], w[3]}) == (w[0], w[1])
+    # the fat link degrades: repeated slow measurements pull the EWMA
+    # under the mid link
+    for _ in range(8):
+        sched._update_tput(w[0], w[1], 1.0)
+    assert sched.A[(w[0], w[1])] < sched.A[(w[2], w[3])]
+    assert sched._pick_pair({w[0], w[1], w[2], w[3]}) == (w[2], w[3])
+
+
 def test_scheduler_greedy_prefers_measured_throughput():
     van = FakeVan()
     sched = TSScheduler(van, num_workers=3, greed_rate=1.0)
@@ -324,6 +362,93 @@ def test_intra_and_inter_ts_combined():
         _parallel([lambda kv=kv: step(kv, -8.0) for kv in topo.workers])
     finally:
         topo.stop()
+
+
+def _shaped_direct_vs_overlay(parties, size, rounds, shape_plan):
+    """Run identical integer-gradient training on a SHAPED in-process
+    HiPS cluster twice — direct global wire, then the inter-DC TSEngine
+    overlay — and return (direct, overlay) final models. Gradients are
+    integer-valued, so float32 summation is exact in ANY merge order:
+    the two wires must agree bit for bit, not just within tolerance."""
+    from geomx_tpu.optimizer import SGD
+    from geomx_tpu.simulate import InProcessHiPS
+
+    w0 = np.arange(size, dtype=np.float32)
+    finals = {}
+    for inter_ts in (False, True):
+        topo = InProcessHiPS(
+            num_parties=parties, workers_per_party=1,
+            extra_cfg=dict(shape_plan=shape_plan,
+                           enable_inter_ts=inter_ts)).start()
+        outs = []
+        try:
+            def master_init(kv):
+                kv.set_optimizer(SGD(learning_rate=1.0))
+                kv.init(0, w0.copy())
+                kv.wait()
+
+            def worker(kv):
+                out = w0.copy()
+                kv.init(0, w0.copy())
+                for r in range(rounds):
+                    kv.push(0, np.full(size, float(r + 1), np.float32))
+                    kv.pull(0, out=out)
+                    kv.wait()
+                outs.append(out.copy())
+
+            topo.run_workers(worker, include_master=master_init,
+                             timeout=600)
+        finally:
+            topo.stop()
+        for o in outs[1:]:
+            np.testing.assert_array_equal(outs[0], o)
+        finals[inter_ts] = outs[0]
+    return finals[False], finals[True]
+
+
+# every global link shaped, with the global server's access pipe as a
+# SHARED bucket — the small-delay twin of scripts/shapes/hetero16.json
+_SHAPED_4P = json.dumps({
+    "seed": 3,
+    "links": [
+        {"dst": 8, "tier": "global", "shared": True,
+         "rtt_ms": 4.0, "bw_mbps": 400.0},
+        {"src": 8, "tier": "global", "shared": True,
+         "rtt_ms": 4.0, "bw_mbps": 400.0},
+    ],
+    "default": {"tier": "global", "rtt_ms": 4.0, "bw_mbps": 400.0},
+})
+
+
+def test_shaped_overlay_round_bit_exact_vs_direct():
+    """A shaped global round through the TSEngine overlay produces the
+    SAME bits as the direct wire (4 parties, shared server access pipe
+    + per-pair shaped links)."""
+    parties, rounds = 4, 2
+    direct, overlay = _shaped_direct_vs_overlay(
+        parties, size=64, rounds=rounds, shape_plan=_SHAPED_4P)
+    np.testing.assert_array_equal(direct, overlay)
+    # and both equal the analytic result: w -= sum_p grad_r each round
+    expect = np.arange(64, dtype=np.float32) - sum(
+        parties * (r + 1) for r in range(rounds))
+    np.testing.assert_array_equal(direct, expect)
+
+
+@pytest.mark.slow
+def test_shaped_hetero16_round_bit_exact_vs_direct():
+    """The full 16-party heterogeneous plan (fat metro / mid / thin
+    transoceanic links, shared server pipe): overlay == direct wire,
+    bit for bit. Slow: two 16-party clusters with 150 ms thin links."""
+    import os
+
+    plan = "@" + os.path.join(os.path.dirname(__file__), "..",
+                              "scripts", "shapes", "hetero16.json")
+    direct, overlay = _shaped_direct_vs_overlay(
+        16, size=64, rounds=2, shape_plan=plan)
+    np.testing.assert_array_equal(direct, overlay)
+    expect = np.arange(64, dtype=np.float32) - sum(
+        16 * (r + 1) for r in range(2))
+    np.testing.assert_array_equal(direct, expect)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Shaped N-party chaos case: WAN shaping + faults + wire sanitizer.
+
+The multi-process chaos matrix (scripts/run_chaos_matrix.sh) tops out
+at 3 parties / 12 processes; this driver scales the same bar to 16-64
+IN-PROCESS parties on a shaped heterogeneous topology
+(scripts/shapes/hetero16.json by default) with the full chaos stack
+composed on top of the link emulation:
+
+- **stragglers**: seeded delay faults on the thin transoceanic
+  parties' global links, ON TOP of their shaped 150 ms / 20 Mbps pipes;
+- **one flapping node**: a party server partitioned from the global
+  tier in repeated windows — the resender must heal each flap, not
+  declare anything dead (heartbeats stay off: a flap is a transport
+  outage, not a membership event);
+- **asymmetric per-link codecs**: the thin parties compress their WAN
+  leg with the 2-bit error-feedback codec while fat parties send raw —
+  per-party codec config exercises mixed encode/decode on one FSA
+  round (results are NOT bit-exact by construction, so the bar is
+  completion, not equality);
+- **GEOMX_WIRE_SANITIZER=1**: every van audits ack-exactly-once,
+  countdown drains and epoch monotonicity; ANY ``WIRE-SANITIZER
+  VIOLATION`` marker fails the run (exit 1), same contract as the
+  matrix's overlap/quant-wire cases.
+
+Same seed => the identical drop/delay/flap schedule AND the identical
+shaped delivery schedule (both planes draw from seeded streams).
+
+    python tools/chaos_sim.py --parties 16 --seed 7
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _fault_plan(thin_ids, flapper, seed):
+    """Stragglers on the thin links, one flapping party server."""
+    return json.dumps({"seed": seed, "rules": [
+        # thin-party gradients straggle: +50-100 ms on half their
+        # frames, beyond what their shaped 20 Mbps pipe already costs
+        {"type": "delay", "src": thin_ids, "tier": "global",
+         "delay_s": 0.05, "jitter_s": 0.05, "p": 0.5},
+        # one mid-tier party flaps: two 1.5 s total outages from the
+        # global tier; the resender replays through each window
+        {"type": "partition", "between": [flapper, "*"],
+         "tier": "global", "start_s": 6.0, "duration_s": 1.5},
+        {"type": "partition", "between": [flapper, "*"],
+         "tier": "global", "start_s": 10.0, "duration_s": 1.5},
+        # background loss on every global link
+        {"type": "drop", "p": 0.05, "tier": "global"},
+    ]})
+
+
+class _MarkerTrap(logging.Handler):
+    """Collect every sanitizer-violation log line as it happens."""
+
+    def __init__(self, marker):
+        super().__init__(level=logging.ERROR)
+        self.marker = marker
+        self.hits = []
+
+    def emit(self, record):
+        msg = record.getMessage()
+        if self.marker in msg:
+            self.hits.append(msg)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--parties", type=int, default=16)
+    ap.add_argument("--size", type=int, default=65536,
+                    help="elements per gradient (float32); default 256KB")
+    ap.add_argument("--rounds", type=int, default=4)
+    ap.add_argument("--shape", default="scripts/shapes/hetero16.json",
+                    help="ShapePlan JSON path or inline JSON; '' = off")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--timeout", type=float, default=600.0)
+    args = ap.parse_args()
+
+    from geomx_tpu.optimizer import SGD
+    from geomx_tpu.ps import base, sanitizer
+    from geomx_tpu.simulate import InProcessHiPS
+
+    n = args.parties
+    gids = [base.worker_rank_to_id(r) for r in range(n)]
+    # mirror hetero16.json's tiers at any party count: last quarter
+    # thin (straggler + 2-bit codec), one mid-tier party flaps
+    thin = list(range(n - max(1, n // 4), n))
+    thin_ids = [gids[p] for p in thin]
+    flapper = gids[n // 2]
+
+    extra = dict(
+        ps_seed=args.seed,
+        fault_plan=_fault_plan(thin_ids, flapper, args.seed),
+        wire_sanitizer=True,
+        # drops/flaps heal through the resender; the deadline outlives
+        # the longest flap window by a wide margin
+        resend=True, resend_timeout_ms=500, resend_deadline_s=120.0,
+    )
+    if args.shape:
+        plan = args.shape.strip()
+        extra["shape_plan"] = plan if plan.startswith(("{", "[", "@")) \
+            else "@" + plan
+    per_party = {p: {"wire_codec_wan": "2bit"} for p in thin}
+
+    trap = _MarkerTrap(sanitizer.MARKER)
+    logging.getLogger("geomx.sanitizer").addHandler(trap)
+
+    print(f"# shaped chaos: {n} parties, {args.size * 4 // 1024} KB "
+          f"gradient, {args.rounds} rounds, seed={args.seed}, "
+          f"shape={args.shape or 'off'}, thin={thin_ids}, "
+          f"flapper={flapper}")
+    t0 = time.perf_counter()
+    topo = InProcessHiPS(num_parties=n, workers_per_party=1,
+                         extra_cfg=extra,
+                         per_party_cfg=per_party).start()
+    finals = []
+    try:
+        def master_init(kv):
+            kv.set_optimizer(SGD(learning_rate=0.1))
+            kv.init(0, np.zeros(args.size, np.float32))
+            kv.wait()
+
+        def worker(kv):
+            out = np.zeros(args.size, np.float32)
+            kv.init(0, np.zeros(args.size, np.float32))
+            for r in range(args.rounds):
+                kv.push(0, np.full(args.size, float(r + 1), np.float32))
+                kv.pull(0, out=out)
+                kv.wait()
+            finals.append(out.copy())
+
+        topo.run_workers(worker, include_master=master_init,
+                         timeout=args.timeout)
+    finally:
+        topo.stop()
+    wall = time.perf_counter() - t0
+
+    ok = True
+    if len(finals) != n:
+        print(f"FAILED: only {len(finals)}/{n} workers completed")
+        ok = False
+    for i, f in enumerate(finals):
+        if not np.all(np.isfinite(f)):
+            print(f"FAILED: worker {i} final model has non-finite values")
+            ok = False
+    if trap.hits:
+        print(f"FAILED: {len(trap.hits)} wire-sanitizer violation(s):")
+        for h in trap.hits[:10]:
+            print("  " + h)
+        ok = False
+    if ok:
+        print(f"OK: {n} shaped chaotic parties completed "
+              f"{args.rounds} rounds in {wall:.1f}s, sanitizer clean")
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

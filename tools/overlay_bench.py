@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""TSEngine overlay vs direct N-to-1 incast on a shaped WAN topology.
+
+Times full global FSA rounds (push + pull + wait, every byte over the
+real transport) on an in-process N-party HiPS cluster whose global
+tier is shaped by a ShapePlan (ps/shaping.py), twice: once over the
+direct wire (every party server pushes its aggregate straight at the
+global server — an N-to-1 incast through the server's shared access
+pipe) and once with the inter-DC TSEngine overlay (party-to-party
+reduction tree up, multicast tree down; only the final merged gradient
+and the first model copy cross the shared pipe). Reproduces the
+PERF.md "overlay vs incast" capture:
+
+    python tools/overlay_bench.py --parties 16 \
+        --shape scripts/shapes/hetero16.json
+
+The run asserts the two wires agree BIT-EXACTLY: gradients are
+integer-valued, so float32 summation is exact in any merge order and
+``np.array_equal`` must hold between the direct and overlay results.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def run(parties: int, size: int, rounds: int, extra_cfg: dict,
+        inter_ts: bool):
+    """One pass; returns (per-round ms, final weights)."""
+    from geomx_tpu.optimizer import SGD
+    from geomx_tpu.simulate import InProcessHiPS
+
+    extra = dict(extra_cfg, enable_inter_ts=inter_ts)
+    w0 = np.zeros(size, np.float32)
+    topo = InProcessHiPS(num_parties=parties, workers_per_party=1,
+                         extra_cfg=extra).start()
+    per_round = {}
+    finals = []
+    try:
+        def master_init(kv):
+            kv.set_optimizer(SGD(learning_rate=1.0))
+            kv.init(0, w0.copy())
+            kv.wait()
+
+        def worker(kv):
+            out = w0.copy()
+            kv.init(0, w0.copy())
+            kv.pull(0, out=out)
+            kv.wait()
+            ts = []
+            for r in range(rounds):
+                # integer-valued so any merge order is bit-exact
+                grad = np.full(size, float(r + 1), np.float32)
+                t0 = time.perf_counter()
+                kv.push(0, grad)
+                kv.pull(0, out=out)
+                kv.wait()
+                ts.append((time.perf_counter() - t0) * 1e3)
+            per_round[id(kv)] = ts
+            finals.append(out.copy())
+
+        topo.run_workers(worker, include_master=master_init,
+                         timeout=1200)
+    finally:
+        topo.stop()
+    for f in finals[1:]:
+        assert np.array_equal(finals[0], f), \
+            "workers disagree on the final model"
+    # the round completes when the SLOWEST party has its model back
+    worst = [max(ts[r] for ts in per_round.values())
+             for r in range(rounds)]
+    return worst, finals[0]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--parties", type=int, default=16)
+    ap.add_argument("--size", type=int, default=262144,
+                    help="elements per gradient (float32); default 1MB")
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--shape", default="scripts/shapes/hetero16.json",
+                    help="ShapePlan JSON path or inline JSON; '' = off")
+    ap.add_argument("--shape-seed", type=int, default=-1)
+    args = ap.parse_args()
+
+    extra = {}
+    if args.shape:
+        plan = args.shape.strip()
+        extra["shape_plan"] = plan if plan.startswith(("{", "[", "@")) \
+            else "@" + plan
+    if args.shape_seed >= 0:
+        extra["shape_seed"] = args.shape_seed
+
+    print(f"# {args.parties} parties, {args.size * 4 // 1024} KB "
+          f"gradient, {args.rounds} rounds, "
+          f"shape={args.shape or 'off'}")
+    direct_ms, direct_w = run(args.parties, args.size, args.rounds,
+                              extra, inter_ts=False)
+    overlay_ms, overlay_w = run(args.parties, args.size, args.rounds,
+                                extra, inter_ts=True)
+    assert np.array_equal(direct_w, overlay_w), \
+        "overlay result diverges from the direct wire"
+
+    d, o = np.median(direct_ms), np.median(overlay_ms)
+    print(f"direct incast : {d:8.1f} ms/round   "
+          f"(rounds: {', '.join(f'{t:.0f}' for t in direct_ms)})")
+    print(f"TS overlay    : {o:8.1f} ms/round   "
+          f"(rounds: {', '.join(f'{t:.0f}' for t in overlay_ms)})")
+    print(f"speedup       : {d / o:8.2f}x   (bit-exact: True)")
+
+
+if __name__ == "__main__":
+    main()

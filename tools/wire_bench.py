@@ -9,6 +9,19 @@ sends 2*n_keys messages. Reproduces the PERF.md captures:
 
     python tools/wire_bench.py --layout cnn          # 10 keys, 178k
     python tools/wire_bench.py --layout transformer  # 75 keys, mixed
+
+``--shape scripts/shapes/wan2_50ms_100mbps.json`` replays any mode on
+an emulated WAN (ps/shaping.py): per-link RTT + token-bucket
+bandwidth on every global-tier data frame. This is the PERF.md
+"shaped pipelined round" capture:
+
+    python tools/wire_bench.py --overlap \
+        --shape scripts/shapes/wan2_50ms_100mbps.json \
+        --trace-out /tmp/shaped_round.json
+
+``--trace-out`` dumps the in-process chrome trace (all nodes, one
+file) — feed it to ``python -m tools.trace_merge`` for the Perfetto
+artifact showing chunks in flight across rounds.
 """
 
 from __future__ import annotations
@@ -31,12 +44,13 @@ LAYOUTS = {
 }
 
 
-def run(shapes, batched: bool, rounds: int) -> float:
+def run(shapes, batched: bool, rounds: int, extra_cfg=None) -> float:
     from geomx_tpu.optimizer import SGD
     from geomx_tpu.simulate import InProcessHiPS
 
     keys = list(range(len(shapes)))
-    topo = InProcessHiPS(num_parties=2, workers_per_party=1).start()
+    topo = InProcessHiPS(num_parties=2, workers_per_party=1,
+                         extra_cfg=extra_cfg).start()
     times = {}
     try:
         def master_init(kv):
@@ -69,7 +83,8 @@ def run(shapes, batched: bool, rounds: int) -> float:
     return max(times.values())
 
 
-def run_sparse(shapes, threshold: float, rounds: int) -> float:
+def run_sparse(shapes, threshold: float, rounds: int,
+               extra_cfg=None) -> float:
     """Protocol-only round time of the HEADLINE sparse path: the
     combined element-sparse BSC wire (push_pull_bsc_batch — what the
     device-resident trainer sends per round), aggregator-mode PS, top-k
@@ -77,7 +92,8 @@ def run_sparse(shapes, threshold: float, rounds: int) -> float:
     from geomx_tpu.simulate import InProcessHiPS
 
     keys = list(range(len(shapes)))
-    topo = InProcessHiPS(num_parties=2, workers_per_party=1).start()
+    topo = InProcessHiPS(num_parties=2, workers_per_party=1,
+                         extra_cfg=extra_cfg).start()
     times = {}
     try:
         def master_init(kv):
@@ -110,19 +126,27 @@ def run_sparse(shapes, threshold: float, rounds: int) -> float:
     return max(times.values())
 
 
-def run_overlap(shapes, rounds: int, slice_bytes: int):
+def run_overlap(shapes, rounds: int, slice_bytes: int,
+                extra_cfg=None, trace_out: str = ""):
     """Serial vs pipelined combined round: the same dense push_pull
     payloads, once through the blocking wire (push_pull + wait) and
     once through the async chunked wire (push_pull_async at
     ``slice_bytes``-budget P3 chunks, joined per round). Per-key host
-    work between dispatch and join is what the pipeline hides."""
+    work between dispatch and join is what the pipeline hides — on a
+    shaped link (``--shape``) so is the link latency itself: chunk k+1
+    serializes while chunk k is in flight."""
+    from geomx_tpu import profiler
     from geomx_tpu.optimizer import SGD
     from geomx_tpu.simulate import InProcessHiPS
 
     keys = list(range(len(shapes)))
+    cfg = dict(extra_cfg or {})
+    cfg["p3_slice_bytes"] = slice_bytes
     topo = InProcessHiPS(num_parties=2, workers_per_party=1,
-                         extra_cfg={"p3_slice_bytes": slice_bytes}
-                         ).start()
+                         extra_cfg=cfg).start()
+    if trace_out:
+        profiler.set_config(filename=trace_out)
+        profiler.set_state("run")
     times = {}
     nchunks = [0]
     try:
@@ -163,6 +187,9 @@ def run_overlap(shapes, rounds: int, slice_bytes: int):
         topo.run_workers(worker, include_master=master_init, timeout=600)
     finally:
         topo.stop()
+        if trace_out:
+            profiler.set_state("stop")
+            profiler.dump(filename=trace_out)
     serial = max(t[0] for t in times.values())
     piped = max(t[1] for t in times.values())
     return serial, piped, nchunks[0]
@@ -183,7 +210,24 @@ def main():
                          "(push_pull vs async chunked push_pull_async)")
     ap.add_argument("--slice-bytes", type=int, default=131072,
                     help="--overlap: P3 chunk budget in bytes")
+    ap.add_argument("--shape", default="",
+                    help="shape-plan JSON path (GEOMX_SHAPE_PLAN): "
+                         "replay the capture on an emulated WAN; "
+                         "canonical plans under scripts/shapes/")
+    ap.add_argument("--shape-seed", type=int, default=-1,
+                    help="--shape: jitter-stream seed "
+                         "(GEOMX_SHAPE_SEED; plan-embedded seed wins)")
+    ap.add_argument("--trace-out", default="",
+                    help="--overlap: dump the in-process chrome trace "
+                         "here (merge with tools/trace_merge.py)")
     args = ap.parse_args()
+
+    extra_cfg = {}
+    shape_tag = ""
+    if args.shape:
+        extra_cfg = {"shape_plan": "@" + args.shape,
+                     "shape_seed": args.shape_seed}
+        shape_tag = os.path.splitext(os.path.basename(args.shape))[0]
 
     shapes = LAYOUTS[args.layout]
     if shapes is None:
@@ -191,26 +235,31 @@ def main():
         shapes = [(int(s),)
                   for s in rng.choice([64, 512, 2048, 8192], 75)]
     if args.overlap:
-        serial, piped, nchunks = run_overlap(shapes, args.rounds,
-                                             args.slice_bytes)
+        serial, piped, nchunks = run_overlap(
+            shapes, args.rounds, args.slice_bytes,
+            extra_cfg=extra_cfg, trace_out=args.trace_out)
         print(json.dumps({
             "layout": args.layout, "keys": len(shapes), "overlap": True,
+            "shape": shape_tag,
             "slice_bytes": args.slice_bytes, "chunks": nchunks,
             "serial_ms_per_round": round(serial, 2),
             "pipelined_ms_per_round": round(piped, 2),
             "speedup": round(serial / piped, 2)}))
         return
     if args.sparse:
-        ms = run_sparse(shapes, args.threshold, args.rounds)
+        ms = run_sparse(shapes, args.threshold, args.rounds,
+                        extra_cfg=extra_cfg)
         print(json.dumps({
             "layout": args.layout, "keys": len(shapes), "sparse": True,
-            "threshold": args.threshold,
+            "shape": shape_tag, "threshold": args.threshold,
             "bsc_push_pull_ms_per_round": round(ms, 2)}))
         return
-    per_key = run(shapes, batched=False, rounds=args.rounds)
-    batched = run(shapes, batched=True, rounds=args.rounds)
+    per_key = run(shapes, batched=False, rounds=args.rounds,
+                  extra_cfg=extra_cfg)
+    batched = run(shapes, batched=True, rounds=args.rounds,
+                  extra_cfg=extra_cfg)
     print(json.dumps({
-        "layout": args.layout, "keys": len(shapes),
+        "layout": args.layout, "keys": len(shapes), "shape": shape_tag,
         "per_key_ms_per_round": round(per_key, 2),
         "batched_ms_per_round": round(batched, 2),
         "speedup": round(per_key / batched, 2)}))
