@@ -212,6 +212,24 @@ class Config:
     # round abort/timeout and sanitizer violations. 0 disables the ring
     flightrec_size: int = 256           # GEOMX_FLIGHTREC_SIZE
     flightrec_dir: str = ""             # GEOMX_FLIGHTREC_DIR ($TMPDIR/geomx_flightrec)
+    # live cluster health plane (ps/linkstate.py): every van estimates
+    # per-(src,dst) RTT/goodput from send->ack spans (needs PS_RESEND=1
+    # for ACKs) and piggybacks a digest on HEARTBEAT frames; schedulers
+    # aggregate into a ClusterHealthBoard with straggler / link-degradation
+    # / epoch-stall detectors, queryable via kv.health() and exported
+    # per-round to GEOMX_HEALTH_DIR (tools/geomx_top.py renders it live)
+    health: bool = False                # GEOMX_HEALTH
+    health_dir: str = ""                # GEOMX_HEALTH_DIR ("" = no export)
+    health_window: int = 16             # GEOMX_HEALTH_WINDOW (samples/link)
+    # degradation fires when windowed bw < factor * its own EWMA baseline
+    health_degrade_factor: float = 0.5  # GEOMX_HEALTH_DEGRADE_FACTOR
+    # straggler fires when a node's round progress lags the cluster max
+    # by >= straggler_rounds for straggler_persist consecutive digests
+    health_straggler_rounds: int = 1    # GEOMX_HEALTH_STRAGGLER_ROUNDS
+    health_straggler_persist: int = 3   # GEOMX_HEALTH_STRAGGLER_PERSIST
+    # link marked lossy when >= this many retransmits land within 2 s
+    health_rtx_burst: int = 5           # GEOMX_HEALTH_RTX_BURST
+    health_stall_s: float = 30.0        # GEOMX_HEALTH_STALL_S (epoch stall)
     verbose: int = 0                    # PS_VERBOSE
     # round-4 verdict item 2: the reference makes its transport deadlines
     # env-tunable (van.cc:527-533 PS_RESEND_TIMEOUT / heartbeat envs);
@@ -371,6 +389,14 @@ def load() -> Config:
         telemetry_dir=env_str("GEOMX_TELEMETRY_DIR"),
         flightrec_size=env_int("GEOMX_FLIGHTREC_SIZE", 256),
         flightrec_dir=env_str("GEOMX_FLIGHTREC_DIR"),
+        health=env_bool("GEOMX_HEALTH"),
+        health_dir=env_str("GEOMX_HEALTH_DIR"),
+        health_window=env_int("GEOMX_HEALTH_WINDOW", 16),
+        health_degrade_factor=env_float("GEOMX_HEALTH_DEGRADE_FACTOR", 0.5),
+        health_straggler_rounds=env_int("GEOMX_HEALTH_STRAGGLER_ROUNDS", 1),
+        health_straggler_persist=env_int("GEOMX_HEALTH_STRAGGLER_PERSIST", 3),
+        health_rtx_burst=env_int("GEOMX_HEALTH_RTX_BURST", 5),
+        health_stall_s=env_float("GEOMX_HEALTH_STALL_S", 30.0),
         verbose=env_int("PS_VERBOSE", 0),
         barrier_timeout_s=env_float("PS_BARRIER_TIMEOUT", 600.0),
         op_timeout_s=env_float("PS_OP_TIMEOUT", 300.0),

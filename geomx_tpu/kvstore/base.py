@@ -39,6 +39,11 @@ class Command:
     #                               (durable recovery; docs/robustness.md)
     REPLICA_FETCH = 13            # recovering server <- peer: full replica
     METRICS = 14                  # worker <- server: telemetry snapshot JSON
+    HEALTH = 15                   # worker <- scheduler: cluster health board
+    #                               JSON (ps/linkstate.py; the value mirrors
+    #                               linkstate.HEALTH_CMD — answered at the
+    #                               VAN level because scheduler Postoffices
+    #                               have no customers)
 
 
 # Data-plane cmd values carried in push meta.head.

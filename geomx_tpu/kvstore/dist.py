@@ -1838,6 +1838,30 @@ class KVStoreDist(KVStore):
                    for b in self.kvw.take_response_bodies(ts) if b]
         return {"worker": telemetry.snapshot(), "servers": servers}
 
+    def health(self, timeout: float = 30.0) -> Dict[str, object]:
+        """Pull the cluster health boards (``ps/linkstate.py``) over the
+        command channel: the LOCAL tier's board straight from this
+        party's scheduler, plus the GLOBAL tier's board relayed through
+        any party server that is a member of both tiers
+        (Command.HEALTH). Returns ``{"local": board_or_None,
+        "global": [board, ...]}`` — boards are the plain-dict form of
+        ``ClusterHealthBoard.render``; None/empty when GEOMX_HEALTH is
+        off or the tier has no board yet."""
+        import json
+
+        ts = self.kvw.request(Command.HEALTH, "", psbase.SCHEDULER)
+        self.kvw.wait(ts, timeout)
+        local = None
+        for b in self.kvw.take_response_bodies(ts):
+            if b and b != "{}":
+                local = json.loads(b)
+        ts = self.kvw.request(Command.HEALTH, "", psbase.SERVER_GROUP)
+        self.kvw.wait(ts, timeout)
+        glob = [json.loads(b)
+                for b in self.kvw.take_response_bodies(ts)
+                if b and b != "{}"]
+        return {"local": local, "global": glob}
+
     def load_optimizer_states(self, fname: str) -> None:
         with open(fname, "rb") as f:
             body = f.read().decode()

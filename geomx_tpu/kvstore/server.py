@@ -1880,6 +1880,19 @@ class KVStoreDistServer:
             # process answers once with both tiers' counters in it
             srv.response(req, body=telemetry.snapshot_json())
             return
+        if head == Command.HEALTH:
+            # cluster health board (ps/linkstate.py): boards live on the
+            # SCHEDULER of each tier, so a server has no board of its
+            # own. A party server is the worker's window into the global
+            # tier — relay the query to the GLOBAL scheduler and answer
+            # with its board JSON; single-tier servers answer empty (the
+            # worker already queried its local scheduler directly).
+            if (self.has_global_tier and not global_tier
+                    and self.worker_global is not None):
+                srv.response(req, body=self._relay_health())
+                return
+            srv.response(req, body="")
+            return
         if head == Command.REPLICA_UPDATE:
             # a peer server's snapshot delta (kvstore/replication.py);
             # accumulate it so we can serve that peer's replacement later
@@ -2022,6 +2035,22 @@ class KVStoreDistServer:
             for resp in self.worker_global.take_response_bodies(ts):
                 merged.update(json.loads(resp))
         return merged
+
+    def _relay_health(self) -> str:
+        """Party server: pull the GLOBAL scheduler's health board for a
+        local worker's ``kv.health()`` query (the global scheduler
+        answers at the van level — see ``Van._answer_health``)."""
+        ts = self.worker_global.request(Command.HEALTH, "", psbase.SCHEDULER)
+        try:
+            self.worker_global.wait(ts, 30.0)
+        except (TimeoutError, RuntimeError) as e:
+            log.warning("health-board fetch from global scheduler "
+                        "failed: %s", e)
+            return ""
+        for resp in self.worker_global.take_response_bodies(ts):
+            if resp:
+                return resp
+        return ""
 
     def _relay_optimizer_states_set(self, body: str) -> None:
         """Party server: forward a restore to every global server

@@ -12,7 +12,12 @@ goes wrong:
 - the van is killed by a FaultPlan crash rule (``van._crash_from_fault``),
 - a WIRE-SANITIZER violation fires (``sanitizer._violate``),
 - a round dies at the caller — ``RoundFuture.wait`` raising
-  ``TimeoutError``/``RoundAborted`` (``kvstore/frontier.py``).
+  ``TimeoutError``/``RoundAborted`` (``kvstore/frontier.py``),
+- the process is shut down — SIGTERM or interpreter exit (reason class
+  ``shutdown``, own ``*_shutdown.json`` file so it never clobbers a
+  crash dump). Clean kills in the chaos matrix leave post-mortems too;
+  only recorders created with an EXPLICIT ``GEOMX_FLIGHTREC_DIR`` are
+  enrolled, so ordinary test runs don't litter ``$TMPDIR``.
 
 Dumps land in ``GEOMX_FLIGHTREC_DIR`` (default: ``$TMPDIR/
 geomx_flightrec``) as ``flightrec_<node>_pid<pid>.json`` — one file per
@@ -29,13 +34,16 @@ reads as "the in-flight round's frames".
 
 from __future__ import annotations
 
+import atexit
 import collections
 import json
 import logging
 import os
+import signal
 import tempfile
 import threading
 import time
+import weakref
 from typing import Any, Callable, Dict, List, Optional
 
 log = logging.getLogger("geomx.flightrec")
@@ -43,6 +51,58 @@ log = logging.getLogger("geomx.flightrec")
 
 def default_dir() -> str:
     return os.path.join(tempfile.gettempdir(), "geomx_flightrec")
+
+
+# -- shutdown dumps ---------------------------------------------------------
+# Recorders with an explicit out_dir enroll here; SIGTERM / interpreter
+# exit dumps every live ring (reason class "shutdown") so clean kills in
+# the chaos matrix leave post-mortems, not just crashes and violations.
+_shutdown_registry: "weakref.WeakSet[FlightRecorder]" = weakref.WeakSet()
+_shutdown_hooks = threading.Lock()
+_hooks_installed = False
+_prev_sigterm: Any = None
+
+
+def dump_all(reason: str) -> List[str]:
+    """Dump every enrolled recorder with a non-empty ring; never raises."""
+    paths = []
+    for rec in list(_shutdown_registry):
+        try:
+            if rec.snapshot():
+                p = rec.dump(reason)
+                if p:
+                    paths.append(p)
+        except Exception:  # noqa: BLE001 — shutdown must not fail louder
+            log.exception("shutdown dump failed")
+    return paths
+
+
+def _on_sigterm(signum, frame) -> None:
+    dump_all("shutdown:sigterm")
+    prev = _prev_sigterm
+    if callable(prev):
+        prev(signum, frame)
+    elif prev != signal.SIG_IGN:
+        # default disposition: restore it and re-deliver so the exit
+        # status still says "killed by SIGTERM"
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+
+def _register_for_shutdown(rec: "FlightRecorder") -> None:
+    global _hooks_installed, _prev_sigterm
+    with _shutdown_hooks:
+        _shutdown_registry.add(rec)
+        if _hooks_installed:
+            return
+        _hooks_installed = True
+    atexit.register(dump_all, "shutdown:atexit")
+    try:
+        _prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        # signals can only be installed from the main thread; vans built
+        # off-main (tests, InProcessHiPS helpers) still get atexit dumps
+        pass
 
 
 class FlightRecorder:
@@ -54,6 +114,8 @@ class FlightRecorder:
         self._node_fn = node_fn
         self.size = max(int(size), 0)
         self.out_dir = out_dir or default_dir()
+        if out_dir and self.size > 0:
+            _register_for_shutdown(self)
         self._lock = threading.Lock()
         self._ring: collections.deque = collections.deque(
             maxlen=self.size or 1)
@@ -103,9 +165,13 @@ class FlightRecorder:
         try:
             if path is None:
                 os.makedirs(self.out_dir, exist_ok=True)
+                # shutdown dumps get their own file: a clean-kill ring
+                # must never overwrite the crash/violation dump that made
+                # the run interesting
+                suffix = "_shutdown" if cls == "shutdown" else ""
                 path = os.path.join(
                     self.out_dir,
-                    f"flightrec_{node}_pid{os.getpid()}.json")
+                    f"flightrec_{node}_pid{os.getpid()}{suffix}.json")
             tmp = f"{path}.tmp.{threading.get_ident()}"
             with open(tmp, "w") as f:
                 json.dump(doc, f, indent=1)

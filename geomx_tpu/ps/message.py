@@ -209,6 +209,11 @@ class Meta:
     trace_chunk: int = -1
     trace_origin: int = -1
 
+    # geomx-healthd: compact per-van link-state digest (JSON) piggybacked
+    # on HEARTBEAT frames — the scheduler's ClusterHealthBoard ingests
+    # it; empty everywhere else so data frames pay zero bytes
+    health: str = ""
+
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {}
         for f in dataclasses.fields(self):
@@ -262,7 +267,7 @@ class Meta:
 # version-mismatch ValueError at decode.
 # ---------------------------------------------------------------------------
 
-BINMETA_VERSION = 3
+BINMETA_VERSION = 4
 
 _META_FIELDS: List[Tuple[str, str]] = [
     ("sender", "i"), ("app_id", "i"), ("customer_id", "i"),
@@ -277,6 +282,7 @@ _META_FIELDS: List[Tuple[str, str]] = [
     ("lossy", "b"), ("num_merge", "i"), ("party_nsrv", "i"),
     ("aux_mask", "I"), ("aux_len", "i"), ("epoch", "i"),
     ("trace_round", "i"), ("trace_chunk", "i"), ("trace_origin", "i"),
+    ("health", "s"),
 ]
 _META_DEFAULTS = {f.name: ([] if isinstance(f.default,
                                             dataclasses._MISSING_TYPE)

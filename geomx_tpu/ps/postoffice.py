@@ -78,6 +78,18 @@ class Postoffice:
             # GEOMX_FLIGHTREC_SIZE/_DIR: crash flight recorder ring
             flightrec_size=cfg.flightrec_size,
             flightrec_dir=cfg.flightrec_dir,
+            # GEOMX_HEALTH*: live link-state estimation + scheduler-side
+            # cluster health board (ps/linkstate.py)
+            health=cfg.health,
+            health_dir=cfg.health_dir,
+            health_opts={
+                "window": cfg.health_window,
+                "degrade_factor": cfg.health_degrade_factor,
+                "straggler_rounds": cfg.health_straggler_rounds,
+                "straggler_persist": cfg.health_straggler_persist,
+                "rtx_burst": cfg.health_rtx_burst,
+                "stall_s": cfg.health_stall_s,
+            },
             # DGT runs on the inter-DC (global) tier only (reference:
             # StartGlobal binds the UDP channels, van.cc:613-646)
             dgt={

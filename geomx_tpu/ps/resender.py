@@ -126,7 +126,20 @@ class Resender:
 
     def handle_ack(self, sig: int) -> None:
         with self._lock:
-            self._outgoing.pop(sig, None)
+            ent = self._outgoing.pop(sig, None)
+        if ent is None:
+            return
+        # geomx-healthd: the send→ack span of a never-retransmitted data
+        # frame is the raw material for per-link RTT/bandwidth estimation
+        # (linkstate.LinkEstimator); retransmitted frames are ambiguous
+        # (the ACK may answer any copy) and control frames carry no
+        # payload worth timing
+        ls = self.van.linkstate
+        if ls is not None:
+            target, msg, t0, _due, n = ent
+            if n == 0 and not msg.is_control:
+                nbytes = sum(len(d) for d in msg.data) if msg.data else 0
+                ls.note_span(target, nbytes, time.monotonic() - t0)
 
     # -- receiver side ---------------------------------------------------
 
@@ -248,11 +261,14 @@ class Resender:
                         target, msg, t0, now + self._backoff(n + 1), n + 1)
                     to_resend.append((target, msg))
             self._fire_give_ups(gave_up)
+            ls = self.van.linkstate
             for target, msg in to_resend:
                 self.num_resends += 1
                 telemetry.counter_inc(
                     "resender.resends",
                     tier="global" if self.van.is_global else "local")
+                if ls is not None:
+                    ls.note_retransmit(target)
                 try:
                     self.van._send_one(target, msg)
                 except OSError as e:

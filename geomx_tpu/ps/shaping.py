@@ -67,8 +67,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from geomx_tpu import telemetry
 from geomx_tpu.ps import faults as faults_mod
+from geomx_tpu.ps import linkstate
 from geomx_tpu.ps.faults import _match
 
 log = logging.getLogger("geomx.shaping")
@@ -289,12 +289,8 @@ class LinkShaper:
                 (src, dst, seq, nbytes, round(delay * 1e3, 6)))
         if delay <= 0.0:
             return True
-        telemetry.gauge_set("link.shaped_delay_ms", delay * 1e3,
-                            src=src, dst=dst,
-                            tier="global" if self.van.is_global else "local")
-        telemetry.counter_inc("link.shaped_bytes", nbytes,
-                              src=src, dst=dst,
-                              tier="global" if self.van.is_global
-                              else "local")
+        tier = "global" if self.van.is_global else "local"
+        linkstate.note_shaped_delay(src, dst, delay, tier=tier)
+        linkstate.note_shaped_bytes(src, dst, nbytes, tier=tier)
         faults_mod.deliver_later(self.van, delay, msg)
         return False

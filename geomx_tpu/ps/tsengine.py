@@ -49,8 +49,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from geomx_tpu import telemetry
-from geomx_tpu.ps import base
+from geomx_tpu.ps import base, linkstate
 from geomx_tpu.ps.kv_app import KVPairs
 from geomx_tpu.ps.message import Control, Message, Meta
 
@@ -317,11 +316,11 @@ class TSNode:
         mb_s = nbytes / dt / 1e6
         # measured push->ack wall time: a shaped link's serialization +
         # RTT lands here, so the scheduler's throughput matrix — and
-        # this observability gauge — reflect emulated WAN conditions
-        telemetry.gauge_set("link.goodput_mb_s", mb_s,
-                            src=self.po.van.my_id, dst=dest,
-                            tier="global" if self.po.van.is_global
-                            else "local")
+        # the link.* observability gauge (emitted via the linkstate
+        # funnel, GX-M402) — reflect emulated WAN conditions
+        linkstate.note_goodput(
+            self.po.van.my_id, dest, mb_s,
+            tier="global" if self.po.van.is_global else "local")
         with self._lock:
             self._reports.append([dest, mb_s])
 

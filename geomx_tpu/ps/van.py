@@ -37,6 +37,7 @@ from geomx_tpu.ps import base
 from geomx_tpu.ps import dgt as dgt_mod
 from geomx_tpu.ps import faults as faults_mod
 from geomx_tpu.ps import native as native_mod
+from geomx_tpu.ps import linkstate as linkstate_mod
 from geomx_tpu.ps import resender as resender_mod
 from geomx_tpu.ps import shaping as shaping_mod
 from geomx_tpu.ps.flightrec import FlightRecorder
@@ -77,6 +78,9 @@ class Van:
         wire_sanitizer: bool = False,
         flightrec_size: int = 256,
         flightrec_dir: str = "",
+        health: bool = False,
+        health_dir: str = "",
+        health_opts: Optional[dict] = None,
     ):
         self.my_role = my_role
         self.is_global = is_global
@@ -134,6 +138,29 @@ class Van:
         # van dies, a round aborts or the sanitizer flags a violation
         self.flightrec = FlightRecorder(self.node_tag, size=flightrec_size,
                                         out_dir=flightrec_dir)
+        # geomx-healthd (GEOMX_HEALTH): every van continuously estimates
+        # per-link RTT/goodput/loss from send→ack spans; non-schedulers
+        # piggyback a digest on their HEARTBEAT frames, the scheduler
+        # aggregates digests into the ClusterHealthBoard and runs the
+        # anomaly detectors. Both stay None when the plane is off so the
+        # wire hot path pays one attribute check.
+        tier = "global" if is_global else "local"
+        opts = health_opts or {}
+        self.linkstate: Optional[linkstate_mod.LinkEstimator] = None
+        self.healthboard: Optional[linkstate_mod.ClusterHealthBoard] = None
+        if health:
+            self.linkstate = linkstate_mod.LinkEstimator(
+                lambda: self.my_id, tier,
+                window=opts.get("window", 16))
+            if my_role == Role.SCHEDULER:
+                self.healthboard = linkstate_mod.ClusterHealthBoard(
+                    tier, self.node_tag, out_dir=health_dir,
+                    degrade_factor=opts.get("degrade_factor", 0.5),
+                    straggler_rounds=opts.get("straggler_rounds", 1),
+                    straggler_persist=opts.get("straggler_persist", 3),
+                    rtx_burst=opts.get("rtx_burst", 5),
+                    stall_s=opts.get("stall_s", 30.0),
+                    flightrec=self.flightrec)
         self.heartbeat_interval_s = heartbeat_interval_s
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.use_priority_send = use_priority_send
@@ -409,6 +436,8 @@ class Van:
                         mts=msg.meta.timestamp)
         telemetry.counter_inc("resender.give_ups",
                               tier="global" if self.is_global else "local")
+        if self.linkstate is not None:
+            self.linkstate.note_give_up(target)
         self.flightrec.record("give_up", peer=target,
                               ts=msg.meta.timestamp, reason=reason,
                               round=msg.meta.trace_round)
@@ -821,6 +850,10 @@ class Van:
             self._process_barrier(msg)
         elif cmd == Control.HEARTBEAT:
             self._heartbeats[msg.meta.sender] = time.monotonic()
+            # geomx-healthd: members piggyback their link-state digest on
+            # the heartbeats they already send; fold it into the board
+            if self.healthboard is not None and msg.meta.health:
+                self.healthboard.ingest(msg.meta.sender, msg.meta.health)
         elif cmd == Control.DEAD_NODE:
             self._process_dead_node(msg)
         # TERMINATE is dispatched but never sent by this tree: it is the
@@ -863,9 +896,40 @@ class Van:
                     profiler.record(
                         "van.recv", "transport", t, 0,
                         self._span_args(msg.meta.recver, msg.meta, nbytes))
+            # geomx-healthd board query (kv.health() -> Command.HEALTH):
+            # answered at van level on the scheduler — the scheduler's
+            # Postoffice registers no customers, so routing this through
+            # msg_handler would drop it
+            if (self.is_scheduler and msg.meta.request
+                    and msg.meta.simple_app
+                    and msg.meta.head == linkstate_mod.HEALTH_CMD):
+                self._answer_health(msg)
+                return
             handler = self.msg_handler
             if handler is not None:
                 handler(msg)
+
+    def _answer_health(self, req: Message) -> None:
+        """Respond to a HEALTH simple_app request with the board JSON
+        (``{}`` when the health plane is off, so callers never hang)."""
+        board = self.healthboard
+        body = board.render_json() if board is not None else "{}"
+        resp = Message(Meta(
+            recver=req.meta.sender,
+            app_id=req.meta.app_id,
+            customer_id=req.meta.customer_id,
+            timestamp=req.meta.timestamp,
+            request=False,
+            simple_app=True,
+            head=req.meta.head,
+            body=body,
+            is_global=self.is_global,
+        ))
+        try:
+            self.send(resp)
+        except OSError as e:
+            log.warning("health response to %d failed: %s",
+                        req.meta.sender, e)
 
     # ------------------------------------------------------------------
     # rendezvous (scheduler + member sides)
@@ -1096,15 +1160,17 @@ class Van:
     def _heartbeat_loop(self) -> None:
         while not self.stopped.wait(self.heartbeat_interval_s):
             try:
-                self.send(
-                    Message(
-                        Meta(
-                            recver=base.SCHEDULER,
-                            control_cmd=Control.HEARTBEAT,
-                            is_global=self.is_global,
-                        )
-                    )
+                meta = Meta(
+                    recver=base.SCHEDULER,
+                    control_cmd=Control.HEARTBEAT,
+                    is_global=self.is_global,
                 )
+                # geomx-healthd: ride the link-state digest on the frame
+                # this loop already sends — zero new per-round messages
+                if self.linkstate is not None:
+                    meta.health = self.linkstate.digest_json(
+                        epoch=self.membership_epoch)
+                self.send(Message(meta))
             except OSError:
                 pass
 
@@ -1269,9 +1335,11 @@ class Van:
 
     def notify_round(self, round_idx: int) -> None:
         """Training-round clock for deterministic fault injection
-        (FaultRule.at_round)."""
+        (FaultRule.at_round) and the health digest's round progress."""
         if self._faults is not None:
             self._faults.on_round(round_idx)
+        if self.linkstate is not None:
+            self.linkstate.note_round(round_idx)
 
     # ------------------------------------------------------------------
 
@@ -1341,6 +1409,13 @@ class Van:
                                   tier=tier, verb=verb, codec=codec)
             telemetry.counter_inc(f"van.messages_{direction}",
                                   tier=tier, verb=verb, codec=codec)
+        ls = self.linkstate
+        if ls is not None:
+            if direction == "sent":
+                ls.note_sent(peer, nbytes, meta.compr or "raw",
+                             meta.trace_round)
+            else:
+                ls.note_recv(peer, meta.trace_round)
 
     def _spawn(self, fn, name: str, *args) -> None:
         t = threading.Thread(target=fn, args=args, name=name, daemon=True)

@@ -46,6 +46,13 @@ from geomx_tpu import profiler
 
 _LabelKey = Tuple[str, Tuple[Tuple[str, Any], ...]]
 
+# version of the snapshot()/snapshot_json() document shape. Downstream
+# consumers (the health board, the item-5 transport controller, chaos
+# matrix collectors) pin on it to detect drift; bump it whenever a
+# top-level key is added/removed/renamed or a value shape changes, and
+# update the gate test in tests/test_telemetry.py in the same change.
+SCHEMA_VERSION = 1
+
 _enabled = False
 _lock = threading.Lock()
 _counters: Dict[_LabelKey, float] = {}
@@ -172,7 +179,8 @@ def snapshot() -> Dict[str, Any]:
                 "max": (None if cnt == 0 else hi),
                 "buckets": list(buckets),
             }
-    return {"counters": counters, "gauges": gauges, "histograms": hists,
+    return {"schema_version": SCHEMA_VERSION, "counters": counters,
+            "gauges": gauges, "histograms": hists,
             "bucket_bounds": list(BUCKETS)}
 
 

@@ -1,6 +1,6 @@
 #!/bin/bash
 # Chaos matrix: the vanilla-HiPS demo (12 processes, 3 parties) run
-# under seven representative seeded fault plans. Every random decision
+# under ten representative seeded fault plans. Every random decision
 # is drawn from PS_SEED-derived streams (geomx_tpu/ps/faults.py), so a
 # failing case reproduces exactly by re-running with the same seed.
 # The resender is always on: the point of each case is that training
@@ -21,6 +21,11 @@
 #               one flapping party server, asymmetric per-link 2-bit
 #               codecs on the thin legs; the wire sanitizer audits
 #               every van and any violation marker fails the case
+#   shaped-16p-health  same 16-party topology with the health plane on
+#               (docs/observability.md): a faulted run must raise
+#               straggler + link-degradation anomalies naming the
+#               planned culprits, then a clean run must raise ZERO
+#               anomaly events — detectors that cry wolf fail the case
 #   worker-kill both data parties' worker 0 crashes at round 3; elastic
 #               membership resizes the round to the survivors
 #   server-kill party A's server crashes mid-round; survivors keep
@@ -150,6 +155,23 @@ if PS_SEED=$SEED JAX_PLATFORMS=cpu \
   echo "=== chaos[shaped-16p] OK ==="
 else
   echo "=== chaos[shaped-16p] FAILED (re-run with PS_SEED=$SEED to reproduce) ==="
+  FAILED=1
+fi
+
+# health-plane closed loop on the same shaped 16-party topology:
+# chaos_sim --health runs the matrix twice — once with planned thin-
+# downlink delays and a control-cut flapping server (the scheduler
+# board must raise straggler and link-degradation anomalies naming
+# those culprits), then once clean (ZERO anomaly events allowed).
+# chaos_sim exits non-zero on a missed detection or a false positive.
+echo "=== chaos[shaped-16p-health] seed=$SEED ==="
+if PS_SEED=$SEED JAX_PLATFORMS=cpu \
+     ${PYTHON:-python} "$(pwd)/../tools/chaos_sim.py" \
+     --parties 16 --seed "$SEED" --health \
+     --shape "$(pwd)/shapes/hetero16.json"; then
+  echo "=== chaos[shaped-16p-health] OK ==="
+else
+  echo "=== chaos[shaped-16p-health] FAILED (re-run with PS_SEED=$SEED to reproduce) ==="
   FAILED=1
 fi
 

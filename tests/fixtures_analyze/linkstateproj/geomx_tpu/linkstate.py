@@ -1,0 +1,13 @@
+"""The link.* funnel itself: raw emitters here are the implementation."""
+
+from geomx_tpu import telemetry
+
+
+def note_goodput(src, dst, mb_s, tier):
+    telemetry.gauge_set("link.goodput_mb_s", mb_s, src=src, dst=dst,
+                        tier=tier)  # exempt: this IS the funnel
+
+
+def note_shaped_bytes(src, dst, nbytes, tier):
+    telemetry.counter_inc("link.shaped_bytes", nbytes, src=src, dst=dst,
+                          tier=tier)  # exempt

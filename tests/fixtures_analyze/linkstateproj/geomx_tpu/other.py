@@ -1,0 +1,30 @@
+"""GX-M402 fixture: link.* metrics set outside the linkstate funnel."""
+
+from geomx_tpu import telemetry
+from geomx_tpu.ps import linkstate
+
+
+class Shaper:
+    def hold(self, src, dst, delay_s):
+        telemetry.gauge_set("link.shaped_delay_ms", delay_s * 1e3,  # GX-M402
+                            src=src, dst=dst, tier="local")
+
+    def carried(self, src, dst, n):
+        telemetry.counter_inc("link.shaped_bytes", n,  # GX-M402
+                              src=src, dst=dst, tier="local")
+
+    def suppressed(self, mb_s):
+        # geomx-lint: disable=GX-M402
+        telemetry.gauge_set("link.goodput_mb_s", mb_s)
+
+    def clean(self, src, dst, delay_s, mb_s):
+        # routed through the funnel: fine
+        linkstate.note_shaped_delay(src, dst, delay_s, tier="local")
+        linkstate.note_goodput(src, dst, mb_s, tier="local")
+        # non-link namespaces are out of scope for M402
+        telemetry.gauge_set("queue.depth", 3, tier="local")
+        telemetry.counter_inc("van.bytes_sent", 10, tier="local")
+
+
+def module_level(bw):
+    telemetry.gauge_set("link.bw_mbps", bw, src=1, dst=2)  # GX-M402

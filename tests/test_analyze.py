@@ -334,6 +334,42 @@ def test_metrics_suppression_and_funnel_exemption():
 
 
 # ---------------------------------------------------------------------------
+# metrics pass (GX-M402: link.* outside the linkstate funnel)
+# ---------------------------------------------------------------------------
+
+def test_link_metric_outside_linkstate_fires():
+    root = FIXTURES / "linkstateproj"
+    sources = load_sources([root / "geomx_tpu"], root)
+    hits = _by_rule(run_metrics(sources), "GX-M402")
+    got = {(h.symbol, h.detail) for h in hits}
+    # pre-suppression: the disable-commented site is still found here
+    assert got == {
+        ("Shaper.hold", "telemetry.gauge_set:link.shaped_delay_ms"),
+        ("Shaper.carried", "telemetry.counter_inc:link.shaped_bytes"),
+        ("Shaper.suppressed", "telemetry.gauge_set:link.goodput_mb_s"),
+        ("module_level", "telemetry.gauge_set:link.bw_mbps"),
+    }
+    # the funnel file itself, linkstate-routed callers and non-link
+    # metric names all stay clean
+    assert all(h.path.endswith("other.py") for h in hits)
+
+
+def test_link_metric_suppression_and_funnel_exemption():
+    root = FIXTURES / "linkstateproj"
+    hits = _by_rule(run_all([root / "geomx_tpu"], root,
+                            passes=["metrics"]), "GX-M402")
+    assert {h.symbol for h in hits} == \
+        {"Shaper.hold", "Shaper.carried", "module_level"}
+
+
+def test_repo_tree_has_no_link_metric_leaks():
+    """Zero new baseline entries: the real tree's only link.* emitter
+    is ps/linkstate.py (tsengine and shaping route through it)."""
+    sources = load_sources([REPO / "geomx_tpu"], REPO)
+    assert _by_rule(run_metrics(sources), "GX-M402") == []
+
+
+# ---------------------------------------------------------------------------
 # plumbing: syntax errors, suppression, baseline
 # ---------------------------------------------------------------------------
 

@@ -6,12 +6,14 @@ are the in-flight round's wire frames.
 import glob
 import json
 import os
+import signal
 
 import numpy as np
 import pytest
 
 from geomx_tpu.optimizer import SGD
 from geomx_tpu.ps import base as psbase
+from geomx_tpu.ps import flightrec
 from geomx_tpu.ps.flightrec import FlightRecorder, default_dir
 from tools import flight_report
 
@@ -100,6 +102,71 @@ def test_node_fn_failure_falls_back_to_unknown(tmp_path):
 
 def test_default_dir_under_tmp():
     assert default_dir().endswith("geomx_flightrec")
+
+
+# ---------------------------------------------------------------------------
+# shutdown dumps (reason class "shutdown": SIGTERM / atexit)
+# ---------------------------------------------------------------------------
+
+def test_shutdown_dump_all_writes_own_file(tmp_path):
+    rec = FlightRecorder(lambda: "n1", size=8, out_dir=str(tmp_path))
+    rec.record("sent", peer=8)
+    paths = flightrec.dump_all("shutdown:atexit")
+    mine = [p for p in paths if str(tmp_path) in p]
+    assert len(mine) == 1
+    assert mine[0].endswith("_shutdown.json")
+    doc = json.loads(open(mine[0]).read())
+    assert doc["reason"] == "shutdown:atexit"
+    assert doc["events"][0]["peer"] == 8
+    # the shutdown class is latched like any other: a second pass (the
+    # atexit hook after a SIGTERM dump) must not re-dump
+    assert [p for p in flightrec.dump_all("shutdown:atexit")
+            if str(tmp_path) in p] == []
+
+
+def test_shutdown_skips_empty_rings_and_default_dir(tmp_path):
+    # empty ring: enrolled but nothing worth a post-mortem
+    FlightRecorder(lambda: "empty", size=8, out_dir=str(tmp_path))
+    # default out_dir: NOT enrolled (ordinary runs must not litter $TMPDIR)
+    implicit = FlightRecorder(lambda: "implicit", size=8)
+    implicit.record("sent", peer=1)
+    assert implicit not in flightrec._shutdown_registry
+    assert [p for p in flightrec.dump_all("shutdown:atexit")
+            if str(tmp_path) in p] == []
+
+
+def test_shutdown_dump_does_not_clobber_crash_dump(tmp_path):
+    rec = FlightRecorder(lambda: "n2", size=8, out_dir=str(tmp_path))
+    rec.record("crash", reason="x")
+    crash = rec.dump("crash:rule #0")
+    shut = rec.dump("shutdown:sigterm")
+    assert crash and shut and shut != crash
+    assert json.loads(open(crash).read())["reason"] == "crash:rule #0"
+
+
+def test_sigterm_dumps_and_preserves_kill_status(tmp_path):
+    """A SIGTERM'd process leaves a shutdown dump AND still dies by
+    SIGTERM (the handler re-delivers the default disposition)."""
+    code = (
+        "import os, signal, sys, time\n"
+        "from geomx_tpu.ps.flightrec import FlightRecorder\n"
+        "rec = FlightRecorder(lambda: 'victim', size=8,"
+        f" out_dir={str(tmp_path)!r})\n"
+        "rec.record('sent', peer=8, verb='push')\n"
+        "os.kill(os.getpid(), signal.SIGTERM)\n"
+        "time.sleep(5)\n"
+        "sys.exit(3)  # unreachable unless the re-kill was swallowed\n"
+    )
+    import subprocess
+    import sys as _sys
+    proc = subprocess.run([_sys.executable, "-c", code], timeout=60,
+                          capture_output=True)
+    assert proc.returncode == -signal.SIGTERM, proc.stderr.decode()
+    dumps = glob.glob(str(tmp_path / "*_shutdown.json"))
+    assert len(dumps) == 1
+    doc = json.loads(open(dumps[0]).read())
+    assert doc["reason"] == "shutdown:sigterm"
+    assert doc["events"][0]["peer"] == 8
 
 
 # ---------------------------------------------------------------------------

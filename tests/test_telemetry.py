@@ -138,6 +138,27 @@ def test_export_round_atomic(tmp_path):
     assert all(".tmp." not in p.name for p in tmp_path.iterdir())
 
 
+def test_snapshot_schema_pinned():
+    """Gate: the snapshot document shape downstream consumers (health
+    board, transport controller) parse. Changing the top-level keys, the
+    histogram value shape, or the version REQUIRES bumping
+    ``telemetry.SCHEMA_VERSION`` and updating this test in the same
+    change."""
+    telemetry.enable(True)
+    telemetry.counter_inc("c", 1)
+    telemetry.gauge_set("g", 2.0)
+    telemetry.histogram_obs("h", 3.0)
+    snap = telemetry.snapshot()
+    assert snap["schema_version"] == telemetry.SCHEMA_VERSION == 1
+    assert set(snap) == {"schema_version", "counters", "gauges",
+                         "histograms", "bucket_bounds"}
+    assert set(snap["histograms"]["h"]) == {"count", "sum", "min", "max",
+                                            "buckets"}
+    assert snap["bucket_bounds"] == list(telemetry.BUCKETS)
+    # the JSON form carries the same version (what export_round writes)
+    assert json.loads(telemetry.snapshot_json())["schema_version"] == 1
+
+
 def test_wan_bytes_sums_global_send_counters_only():
     telemetry.enable(True)
     telemetry.counter_inc("van.bytes_sent", 100, tier="global", verb="push",

@@ -109,6 +109,31 @@ def test_unlinked_node_defaults_to_zero_offset():
     assert offsets["C"] == 0.0
 
 
+def test_disconnected_node_warns_and_still_merges(capsys):
+    """A node with no matched send/recv pair to the reference (e.g. it
+    crashed before answering anything) must not fail the merge: it is
+    kept at offset 0, warned about on stderr, and flagged in the
+    metadata for downstream consumers."""
+    nodes = _skewed_pair()
+    # C talks only to itself: wire pairs exist but never cross to A/B
+    nodes["C"] = [
+        _span("van.send", "C", 100.0, 10, frm=5, to=5, mts=900, req=True),
+        _span("van.recv", "C", 200.0, 5, frm=5, to=5, mts=900, req=True),
+    ]
+    doc = trace_merge.merge(nodes, reference="A")
+    err = capsys.readouterr().err
+    assert "node C" in err and "offset 0" in err
+    assert doc["metadata"]["unanchored_nodes"] == ["C"]
+    assert doc["metadata"]["clock_offsets_us"]["C"] == 0.0
+    # C's events made it into the merged trace on their own pid
+    c_pids = {e["pid"] for e in doc["traceEvents"]
+              if (e.get("args") or {}).get("node") == "C"}
+    assert len(c_pids) == 1
+    # the connected pair still aligns normally, and nothing else is
+    # flagged
+    assert doc["metadata"]["clock_offsets_us"]["B"] == pytest.approx(50_000)
+
+
 def test_load_nodes_splits_by_node_arg(tmp_path):
     merged = tmp_path / "all.json"
     merged.write_text(json.dumps({"traceEvents": [

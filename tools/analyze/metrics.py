@@ -14,6 +14,15 @@ spans) stay first-class: spans are trace-only by design.
 The three ``replication.py`` instants predate the funnel and are
 accepted in the committed baseline; new code must use the funnel or
 carry an explicit ``geomx-lint: disable=GX-M401``.
+
+GX-M402 (warning) a ``link.*`` metric set outside ``ps/linkstate.py``.
+The measurement plane (geomx-healthd) is single-sourced: every
+per-link gauge/counter — measured goodput, emulated shaping holds,
+estimator RTT/bandwidth — goes through the ``linkstate`` note_*
+helpers so link metric names and label shapes (src/dst/tier) cannot
+drift per call site, and the health board's consumers can trust one
+emitter. Same spirit as GX-M401, scoped to the ``link.`` name prefix
+on ``telemetry.gauge_set``/``telemetry.counter_inc``.
 """
 
 from __future__ import annotations
@@ -24,6 +33,12 @@ from typing import List, Optional, Sequence
 from .core import Finding, SEV_WARNING, SourceFile, call_name, const_str
 
 _RAW_CALLS = {"profiler.instant", "profiler.counter"}
+
+# GX-M402: the telemetry mutators whose first (name) argument is checked
+# for the reserved ``link.`` metric namespace
+_LINK_CALLS = {"telemetry.gauge_set", "gauge_set",
+               "telemetry.counter_inc", "counter_inc",
+               "telemetry.sample", "sample"}
 
 
 def _index_functions(tree: ast.Module):
@@ -59,25 +74,40 @@ def run_metrics(sources: Sequence[SourceFile]) -> List[Finding]:
     for src in sources:
         if src.tree is None:
             continue
-        # the funnel itself is the one legitimate raw caller
-        if src.rel.rsplit("/", 1)[-1] == "telemetry.py":
-            continue
+        fname = src.rel.rsplit("/", 1)[-1]
+        # each rule exempts its own funnel: telemetry.py is the one
+        # legitimate raw profiler caller (M401), linkstate.py the one
+        # legitimate link.* emitter (M402)
+        is_telemetry = fname == "telemetry.py"
+        is_linkstate = fname == "linkstate.py"
         fns = _index_functions(src.tree)
         for node in ast.walk(src.tree):
             if not isinstance(node, ast.Call):
                 continue
             nm = call_name(node.func)
-            if nm not in _RAW_CALLS:
-                continue
             evname = const_str(node.args[0]) if node.args else None
-            findings.append(Finding(
-                "GX-M401", SEV_WARNING, src.rel, node.lineno,
-                symbol=_enclosing(fns, node.lineno) or "<module>",
-                detail=f"{nm}:{evname or node.lineno}",
-                message=(f"{nm}"
-                         f"({evname!r}) " if evname else f"{nm}() ")
-                + ("bypasses the telemetry funnel — the event never "
-                   "reaches the metrics registry (kv.metrics(), "
-                   "per-round snapshots); use telemetry.event() / "
-                   "telemetry.sample() instead")))
+            if nm in _RAW_CALLS and not is_telemetry:
+                findings.append(Finding(
+                    "GX-M401", SEV_WARNING, src.rel, node.lineno,
+                    symbol=_enclosing(fns, node.lineno) or "<module>",
+                    detail=f"{nm}:{evname or node.lineno}",
+                    message=(f"{nm}"
+                             f"({evname!r}) " if evname else f"{nm}() ")
+                    + ("bypasses the telemetry funnel — the event never "
+                       "reaches the metrics registry (kv.metrics(), "
+                       "per-round snapshots); use telemetry.event() / "
+                       "telemetry.sample() instead")))
+            elif (nm in _LINK_CALLS and not is_linkstate
+                    and evname is not None
+                    and evname.startswith("link.")):
+                findings.append(Finding(
+                    "GX-M402", SEV_WARNING, src.rel, node.lineno,
+                    symbol=_enclosing(fns, node.lineno) or "<module>",
+                    detail=f"{nm}:{evname}",
+                    message=(f"{nm}({evname!r}) sets a link.* metric "
+                             "outside ps/linkstate.py — the measurement "
+                             "plane is single-sourced; route it through "
+                             "a linkstate note_* helper so link metric "
+                             "names and src/dst/tier labels cannot "
+                             "drift per call site")))
     return findings

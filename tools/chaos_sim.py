@@ -23,10 +23,24 @@ composed on top of the link emulation:
   VIOLATION`` marker fails the run (exit 1), same contract as the
   matrix's overlap/quant-wire cases.
 
+``--health`` turns the same topology into a closed-loop check of the
+cluster health plane (geomx_tpu/ps/linkstate.py): heartbeats carry
+per-link digests to the schedulers, workers drive combined push_pull
+rounds (so the board's round clock advances), and the faults are
+reshaped into what the anomaly detectors are FOR — heavier straggler
+delays on the thin parties, the same flapping party server, no
+background loss. The run fails unless the board raised a straggler
+event naming a planned straggler (thin party or the flapper) AND a
+link-degradation event naming the flapper; a second, un-faulted run on
+the identical shaped topology must then raise ZERO ``HEALTH-ANOMALY``
+markers — the detectors key on injected faults, not on shaping or
+scheduling noise.
+
 Same seed => the identical drop/delay/flap schedule AND the identical
 shaped delivery schedule (both planes draw from seeded streams).
 
     python tools/chaos_sim.py --parties 16 --seed 7
+    python tools/chaos_sim.py --parties 16 --seed 7 --health
 """
 
 from __future__ import annotations
@@ -35,6 +49,7 @@ import argparse
 import json
 import logging
 import os
+import re
 import sys
 import time
 
@@ -62,11 +77,36 @@ def _fault_plan(thin_ids, flapper, seed):
     ]})
 
 
-class _MarkerTrap(logging.Handler):
-    """Collect every sanitizer-violation log line as it happens."""
+def _health_fault_plan(thin_ids, flapper, seed):
+    """The health-mode plan: faults the anomaly detectors exist for.
 
-    def __init__(self, marker):
-        super().__init__(level=logging.ERROR)
+    The straggler delays sit on the thin parties' DOWNLINK (dst) —
+    round progress is stamped when a node issues its combined round,
+    so only delaying what a party must RECEIVE before its next round
+    (the global pull response) makes its round clock genuinely lag the
+    cluster; +1.0 s is several heartbeat refreshes past the board's
+    persistence bar. The flap windows set ``"control": true`` so the
+    flapper's heartbeat/digest stream is cut too: its board entry goes
+    stale (straggler signal) and the severed heartbeats per window
+    retransmit after heal as one burst (loss-degradation signal). No
+    background loss: every raised event must be attributable to a
+    planned fault.
+    """
+    return json.dumps({"seed": seed, "rules": [
+        {"type": "delay", "dst": thin_ids, "tier": "global",
+         "delay_s": 1.0, "jitter_s": 0.2, "p": 0.9},
+        {"type": "partition", "between": [flapper, "*"], "control": True,
+         "tier": "global", "start_s": 3.0, "duration_s": 1.5},
+        {"type": "partition", "between": [flapper, "*"], "control": True,
+         "tier": "global", "start_s": 6.5, "duration_s": 1.5},
+    ]})
+
+
+class _MarkerTrap(logging.Handler):
+    """Collect every marker-carrying log line as it happens."""
+
+    def __init__(self, marker, level=logging.ERROR):
+        super().__init__(level=level)
         self.marker = marker
         self.hits = []
 
@@ -79,17 +119,27 @@ class _MarkerTrap(logging.Handler):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--parties", type=int, default=16)
-    ap.add_argument("--size", type=int, default=65536,
-                    help="elements per gradient (float32); default 256KB")
+    ap.add_argument("--size", type=int, default=None,
+                    help="elements per gradient (float32); default "
+                         "256KB, or 64KB with --health (smaller rounds "
+                         "keep the shared incast pipe's intrinsic "
+                         "queueing skew under the straggler bar)")
     ap.add_argument("--rounds", type=int, default=4)
     ap.add_argument("--shape", default="scripts/shapes/hetero16.json",
                     help="ShapePlan JSON path or inline JSON; '' = off")
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--timeout", type=float, default=600.0)
+    ap.add_argument("--health", action="store_true",
+                    help="health-plane closed loop: faulted run must "
+                         "raise straggler + link-degradation events "
+                         "for the planned culprits; a clean run on the "
+                         "same shaped topology must raise none")
     args = ap.parse_args()
+    size = args.size if args.size is not None \
+        else (16384 if args.health else 65536)
 
     from geomx_tpu.optimizer import SGD
-    from geomx_tpu.ps import base, sanitizer
+    from geomx_tpu.ps import base, linkstate, sanitizer
     from geomx_tpu.simulate import InProcessHiPS
 
     n = args.parties
@@ -100,14 +150,44 @@ def main():
     thin_ids = [gids[p] for p in thin]
     flapper = gids[n // 2]
 
+    rounds = max(args.rounds, 8) if args.health else args.rounds
     extra = dict(
         ps_seed=args.seed,
-        fault_plan=_fault_plan(thin_ids, flapper, args.seed),
+        fault_plan=(_health_fault_plan(thin_ids, flapper, args.seed)
+                    if args.health
+                    else _fault_plan(thin_ids, flapper, args.seed)),
         wire_sanitizer=True,
         # drops/flaps heal through the resender; the deadline outlives
         # the longest flap window by a wide margin
         resend=True, resend_timeout_ms=500, resend_deadline_s=120.0,
     )
+    if args.health:
+        extra.update(
+            health=True,
+            # digests ride heartbeats; a node's straggler streak
+            # advances only on its OWN digests, so at a 0.2 s cadence
+            # the 4-refresh persistence bar means "lagging for ~0.8 s
+            # straight" — above the shared incast pipe's intrinsic
+            # queueing skew (~0.4 s at 64 KB gradients), below the
+            # +1.0 s injected downlink delays. The flaps are transport
+            # outages, NOT membership events: the timeout outlives
+            # the run.
+            heartbeat_interval_s=0.2, heartbeat_timeout_s=60,
+            # the shared 25 Mbps incast pipe to the global server
+            # legitimately queues ~1 s at 16 parties: the retransmit
+            # timeout must clear that or the CLEAN run retransmits
+            # (and the board would call the queueing "loss")
+            resend_timeout_ms=3000,
+            # burst-only degradation: on a shared incast pipe each
+            # flow's implied bandwidth is a queueing lottery, so the
+            # bw-vs-own-baseline detector is off (factor 0) and the
+            # flap must surface through retransmit bursts instead.
+            # The FSA rounds legitimately pause during a flap, so the
+            # stall detector is parked out of reach.
+            health_degrade_factor=0.0, health_rtx_burst=3,
+            health_stall_s=300.0,
+            health_straggler_rounds=1, health_straggler_persist=4,
+        )
     if args.shape:
         plan = args.shape.strip()
         extra["shape_plan"] = plan if plan.startswith(("{", "[", "@")) \
@@ -116,36 +196,48 @@ def main():
 
     trap = _MarkerTrap(sanitizer.MARKER)
     logging.getLogger("geomx.sanitizer").addHandler(trap)
+    htrap = _MarkerTrap(linkstate.MARKER, level=logging.WARNING)
+    logging.getLogger("geomx.health").addHandler(htrap)
 
-    print(f"# shaped chaos: {n} parties, {args.size * 4 // 1024} KB "
-          f"gradient, {args.rounds} rounds, seed={args.seed}, "
-          f"shape={args.shape or 'off'}, thin={thin_ids}, "
-          f"flapper={flapper}")
-    t0 = time.perf_counter()
-    topo = InProcessHiPS(num_parties=n, workers_per_party=1,
-                         extra_cfg=extra,
-                         per_party_cfg=per_party).start()
-    finals = []
-    try:
-        def master_init(kv):
-            kv.set_optimizer(SGD(learning_rate=0.1))
-            kv.init(0, np.zeros(args.size, np.float32))
-            kv.wait()
-
-        def worker(kv):
-            out = np.zeros(args.size, np.float32)
-            kv.init(0, np.zeros(args.size, np.float32))
-            for r in range(args.rounds):
-                kv.push(0, np.full(args.size, float(r + 1), np.float32))
-                kv.pull(0, out=out)
+    def one_run(extra_cfg, label):
+        print(f"# shaped chaos[{label}]: {n} parties, "
+              f"{size * 4 // 1024} KB gradient, {rounds} rounds, "
+              f"seed={args.seed}, shape={args.shape or 'off'}, "
+              f"thin={thin_ids}, flapper={flapper}")
+        t0 = time.perf_counter()
+        topo = InProcessHiPS(num_parties=n, workers_per_party=1,
+                             extra_cfg=extra_cfg,
+                             per_party_cfg=per_party).start()
+        finals = []
+        try:
+            def master_init(kv):
+                kv.set_optimizer(SGD(learning_rate=0.1))
+                kv.init(0, np.zeros(size, np.float32))
                 kv.wait()
-            finals.append(out.copy())
 
-        topo.run_workers(worker, include_master=master_init,
-                         timeout=args.timeout)
-    finally:
-        topo.stop()
-    wall = time.perf_counter() - t0
+            def worker(kv):
+                out = np.zeros(size, np.float32)
+                kv.init(0, np.zeros(size, np.float32))
+                for r in range(rounds):
+                    if args.health:
+                        # combined rounds stamp Meta.trace_round — the
+                        # clock the board's straggler detector runs on
+                        kv.push_pull(0, np.full(size, float(r + 1),
+                                                np.float32), out)
+                    else:
+                        kv.push(0, np.full(size, float(r + 1),
+                                           np.float32))
+                        kv.pull(0, out=out)
+                    kv.wait()
+                finals.append(out.copy())
+
+            topo.run_workers(worker, include_master=master_init,
+                             timeout=args.timeout)
+        finally:
+            topo.stop()
+        return finals, time.perf_counter() - t0
+
+    finals, wall = one_run(extra, "faulted" if args.health else "chaos")
 
     ok = True
     if len(finals) != n:
@@ -160,9 +252,51 @@ def main():
         for h in trap.hits[:10]:
             print("  " + h)
         ok = False
+
+    if args.health:
+        planned = set(thin_ids) | {flapper}
+        stragglers = [int(m.group(1)) for m in
+                      (re.search(r"\bnode=(\d+)", h) for h in htrap.hits
+                       if " straggler " in h) if m]
+        degraded = [(int(m.group(1)), int(m.group(2))) for m in
+                    (re.search(r"\bsrc=(\d+) dst=(\d+)", h)
+                     for h in htrap.hits if " link_degraded " in h) if m]
+        print(f"# health[faulted]: {len(htrap.hits)} anomaly marker(s); "
+              f"stragglers={sorted(set(stragglers))}, "
+              f"degraded={sorted(set(degraded))}")
+        if not any(s in planned for s in stragglers):
+            print(f"FAILED: no straggler event named a planned culprit "
+                  f"(thin {thin_ids} or flapper {flapper}); "
+                  f"got {sorted(set(stragglers))}")
+            ok = False
+        if not any(flapper in (s, d) for s, d in degraded):
+            print(f"FAILED: no link-degradation event named the "
+                  f"flapping server {flapper}; "
+                  f"got {sorted(set(degraded))}")
+            ok = False
+
+        # clean control run: identical shaped topology, no fault plan —
+        # the detectors must stay silent (no events from shaping alone)
+        htrap.hits = []
+        clean_extra = {k: v for k, v in extra.items() if k != "fault_plan"}
+        clean_finals, clean_wall = one_run(clean_extra, "clean")
+        if len(clean_finals) != n:
+            print(f"FAILED: clean run: only {len(clean_finals)}/{n} "
+                  f"workers completed")
+            ok = False
+        if htrap.hits:
+            print(f"FAILED: clean run raised {len(htrap.hits)} "
+                  f"anomaly event(s):")
+            for h in htrap.hits[:10]:
+                print("  " + h)
+            ok = False
+        wall += clean_wall
+
     if ok:
+        bar = ("health events fire on faults only, sanitizer clean"
+               if args.health else "sanitizer clean")
         print(f"OK: {n} shaped chaotic parties completed "
-              f"{args.rounds} rounds in {wall:.1f}s, sanitizer clean")
+              f"{rounds} rounds in {wall:.1f}s, {bar}")
     sys.exit(0 if ok else 1)
 
 

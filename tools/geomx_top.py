@@ -1,0 +1,168 @@
+"""geomx_top: live terminal dashboard over the cluster health board.
+
+``geomx_tpu.ps.linkstate.ClusterHealthBoard`` exports one JSON board
+per scheduler into ``GEOMX_HEALTH_DIR`` each time the cluster round
+clock advances (``board_<node>_round<N>.json``). This tool renders the
+freshest board per scheduler as a top(1)-style screen — node liveness /
+round progress / straggler flags, per-link RTT/bandwidth/loss, and the
+recent anomaly events — refreshing in place until interrupted.
+
+Usage::
+
+    python -m tools.geomx_top /tmp/health            # live view
+    python -m tools.geomx_top /tmp/health --once     # one frame, no ANSI
+    python -m tools.geomx_top /tmp/health --once --json   # raw boards
+
+With no directory argument the ``GEOMX_HEALTH_DIR`` environment
+variable is used.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+_BOARD_RE = re.compile(r"^board_(?P<node>.+)_round(?P<round>\d+)\.json$")
+
+
+def find_boards(health_dir: str) -> Dict[str, Tuple[int, str]]:
+    """Freshest export per scheduler node: {node: (round, path)}."""
+    latest: Dict[str, Tuple[int, str]] = {}
+    try:
+        names = os.listdir(health_dir)
+    except OSError:
+        return latest
+    for name in names:
+        m = _BOARD_RE.match(name)
+        if m is None:
+            continue
+        node, rnd = m.group("node"), int(m.group("round"))
+        if node not in latest or rnd > latest[node][0]:
+            latest[node] = (rnd, os.path.join(health_dir, name))
+    return latest
+
+
+def load_boards(health_dir: str) -> List[dict]:
+    """Parse the freshest board per scheduler, skipping torn reads
+    (exports are atomic renames, so a parse failure means the file
+    vanished mid-scan — the next refresh gets it)."""
+    boards = []
+    for node, (_rnd, path) in sorted(find_boards(health_dir).items()):
+        try:
+            with open(path, "r") as f:
+                boards.append(json.load(f))
+        except (OSError, ValueError):
+            continue
+    return boards
+
+
+def _bar(value: float, full: float, width: int = 10) -> str:
+    if full <= 0:
+        return " " * width
+    n = max(0, min(width, int(round(width * value / full))))
+    return "#" * n + "." * (width - n)
+
+
+def render_board(board: dict, now: Optional[float] = None) -> str:
+    """One board as a text block (pure function: tested directly)."""
+    out: List[str] = []
+    counts = board.get("event_counts", {})
+    badge = ("  !! " + " ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+             if counts else "")
+    out.append(f"== {board.get('tier', '?')} board @ {board.get('node', '?')}"
+               f"  round={board.get('max_round', -1)}"
+               f"  v{board.get('version', 0)}{badge}")
+    nodes = board.get("nodes", {})
+    if nodes:
+        out.append("  node      round  epoch   age_s  flags")
+        for n in sorted(nodes, key=lambda s: int(s) if s.isdigit() else 0):
+            st = nodes[n]
+            flags = "STRAGGLER" if st.get("straggler") else ""
+            out.append(f"  {n:>6}  {st.get('round', -1):>7}"
+                       f"  {st.get('epoch', 0):>5}"
+                       f"  {st.get('age_s', 0.0):>6.1f}  {flags}")
+    links = board.get("links", {})
+    if links:
+        peak = max((lk.get("bw_mbps", 0.0) for lk in links.values()),
+                   default=0.0)
+        out.append("  link        rtt_ms   bw_mbps  "
+                   + "bw".ljust(10) + "  rtx  gu  flags")
+        for name in sorted(links):
+            lk = links[name]
+            flags = "DEGRADED" if lk.get("degraded") else ""
+            out.append(
+                f"  {name:>8}  {lk.get('rtt_ms', 0.0):>8.1f}"
+                f"  {lk.get('bw_mbps', 0.0):>8.1f}"
+                f"  {_bar(lk.get('bw_mbps', 0.0), peak)}"
+                f"  {lk.get('rtx', 0):>3}  {lk.get('give_ups', 0):>2}"
+                f"  {flags}")
+    events = board.get("events", [])
+    if events:
+        out.append("  recent events:")
+        for ev in events[-8:]:
+            fields = " ".join(f"{k}={v}" for k, v in ev.items()
+                              if k not in ("kind", "t"))
+            out.append(f"    t+{ev.get('t', 0.0):<8.1f}"
+                       f" {ev.get('kind', '?'):<14} {fields}")
+    return "\n".join(out)
+
+
+def render_screen(boards: List[dict], health_dir: str) -> str:
+    head = (f"geomx_top — {health_dir} — "
+            f"{time.strftime('%H:%M:%S')} — {len(boards)} board(s)")
+    if not boards:
+        return (head + "\n  (no board_*.json yet — is GEOMX_HEALTH=1 "
+                "and GEOMX_HEALTH_DIR set on the scheduler?)")
+    return "\n\n".join([head] + [render_board(b) for b in boards])
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="live dashboard over GEOMX_HEALTH_DIR board exports")
+    ap.add_argument("health_dir", nargs="?",
+                    default=os.environ.get("GEOMX_HEALTH_DIR", ""),
+                    help="board export dir (default: $GEOMX_HEALTH_DIR)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no ANSI)")
+    ap.add_argument("--json", action="store_true",
+                    help="with --once: dump the raw board dicts as JSON")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="refresh period in seconds (default 1.0)")
+    args = ap.parse_args(argv)
+    if not args.health_dir:
+        ap.error("no health dir: pass one or set GEOMX_HEALTH_DIR")
+    if args.json and not args.once:
+        ap.error("--json requires --once")
+    try:
+        if args.once:
+            boards = load_boards(args.health_dir)
+            if args.json:
+                print(json.dumps(boards, indent=2))
+            else:
+                print(render_screen(boards, args.health_dir))
+            return 0 if boards else 1
+        while True:
+            frame = render_screen(load_boards(args.health_dir),
+                                  args.health_dir)
+            # home + clear-below keeps the refresh flicker-free
+            sys.stdout.write("\x1b[H\x1b[2J" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(max(0.1, args.interval))
+    except KeyboardInterrupt:
+        return 0
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe — a normal way out
+        try:
+            sys.stdout.close()
+        except OSError:
+            pass
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -108,11 +108,14 @@ def _link_deltas(nodes: Dict[str, List[dict]]):
     return deltas, matched
 
 
-def solve_offsets(nodes: Dict[str, List[dict]],
-                  reference: str | None = None):
+def _solve(nodes: Dict[str, List[dict]],
+           reference: str | None = None):
     """offset[node]: subtract from that node's timestamps to land on
     the reference clock. NTP pairing per bidirectional link, BFS from
-    the reference for transitive reach."""
+    the reference for transitive reach. Also returns the nodes the BFS
+    never reached (no matched send/recv pair connects them to the
+    reference, even transitively) — they stay on their own clock at
+    offset 0 rather than failing the whole merge."""
     deltas, matched = _link_deltas(nodes)
     # symmetric-link offset: delta(A->B) = lat + off_B - off_A and
     # delta(B->A) = lat + off_A - off_B  =>  off_B - off_A =
@@ -140,15 +143,30 @@ def solve_offsets(nodes: Dict[str, List[dict]],
             if a == cur and b not in offsets:
                 offsets[b] = offsets[a] + off
                 frontier.append(b)
-    for node in nodes:
-        offsets.setdefault(node, 0.0)  # unreachable: best effort
+    unanchored = sorted(n for n in nodes if n not in offsets)
+    for node in unanchored:
+        offsets[node] = 0.0  # unreachable: best effort, own clock
+    return offsets, matched, unanchored
+
+
+def solve_offsets(nodes: Dict[str, List[dict]],
+                  reference: str | None = None):
+    """Public 2-tuple form of :func:`_solve` (offsets, matched)."""
+    offsets, matched, _unanchored = _solve(nodes, reference)
     return offsets, matched
 
 
 def merge(nodes: Dict[str, List[dict]],
           reference: str | None = None) -> dict:
-    """One chrome-trace doc: pid per node, timestamps clock-aligned."""
-    offsets, matched = solve_offsets(nodes, reference)
+    """One chrome-trace doc: pid per node, timestamps clock-aligned.
+    Nodes disconnected from the reference are kept (offset 0, flagged
+    in ``metadata.unanchored_nodes`` and warned about) — a crashed node
+    whose dump never matched a wire pair still shows on the timeline."""
+    offsets, matched, unanchored = _solve(nodes, reference)
+    for node in unanchored:
+        print(f"warning: node {node} has no matched send/recv pair "
+              f"connecting it to the reference clock — keeping it at "
+              f"offset 0 (its rows may be skewed)", file=sys.stderr)
     out: List[dict] = []
     for pid, node in enumerate(sorted(nodes)):
         out.append({"name": "process_name", "ph": "M", "pid": pid,
@@ -164,7 +182,8 @@ def merge(nodes: Dict[str, List[dict]],
             out.append(ev)
     return {"traceEvents": out, "displayTimeUnit": "ms",
             "metadata": {"clock_offsets_us": offsets,
-                         "matched_wire_pairs": matched}}
+                         "matched_wire_pairs": matched,
+                         "unanchored_nodes": unanchored}}
 
 
 def rounds_spanning(doc: dict) -> Dict[int, set]:
