@@ -200,6 +200,13 @@ class Config:
     # sends-to-dead on its own traffic, and reports at stop(); the
     # dynamic dual of the GX-P3xx protocol pass. Test/chaos-matrix aid
     wire_sanitizer: bool = False        # GEOMX_WIRE_SANITIZER
+    # runtime lock/race sanitizer (ps/locks.py): traced lock primitives
+    # feed a process-global witness that flags lock-order inversions,
+    # blocking calls under a lock, Condition.wait with other locks held
+    # and unguarded writes to @guarded_by fields; the dynamic dual of
+    # the GX-L005..L007 lockmodel pass. Off-path cost is one branch at
+    # lock construction. Test/chaos-matrix aid
+    lock_sanitizer: bool = False        # GEOMX_LOCK_SANITIZER
     # ---- telemetry / flight recorder (ours; docs/observability.md) ----
     # metrics registry (geomx_tpu/telemetry.py): labeled counters/gauges/
     # histograms fed by the van, resender, servers and round futures;
@@ -385,6 +392,7 @@ def load() -> Config:
         epoch_grace_s=env_float("PS_EPOCH_GRACE", 0.0),
         chunk_retries=env_int("PS_CHUNK_RETRIES", 0),
         wire_sanitizer=env_bool("GEOMX_WIRE_SANITIZER"),
+        lock_sanitizer=env_bool("GEOMX_LOCK_SANITIZER"),
         telemetry=env_bool("GEOMX_TELEMETRY"),
         telemetry_dir=env_str("GEOMX_TELEMETRY_DIR"),
         flightrec_size=env_int("GEOMX_FLIGHTREC_SIZE", 256),

@@ -45,6 +45,7 @@ import numpy as np
 from geomx_tpu import checkpoint, profiler
 from geomx_tpu.kvstore.base import Command
 from geomx_tpu.ps import base as psbase
+from geomx_tpu.ps import locks
 
 log = logging.getLogger("geomx.replication")
 
@@ -53,6 +54,8 @@ log = logging.getLogger("geomx.replication")
 _REPLICA_CID = 3
 
 
+@locks.guarded_by("_lock", "_snap_versions", "_cache", "_replica_store",
+                  "_last_updater_blob", "num_snapshots")
 class ReplicationManager:
     """Snapshot/replica engine owned by one ``KVStoreDistServer``."""
 
@@ -66,7 +69,7 @@ class ReplicationManager:
         # tests assert on it to confirm recovery was NOT a re-init
         self.restored_from: Optional[str] = None
         self.num_snapshots = 0
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("ReplicationManager._lock")
         # (key, offset) -> last snapshotted version
         self._snap_versions: Dict[Tuple[int, int], int] = {}
         # merged snapshot image: (key, offset) -> entry dict
@@ -209,25 +212,34 @@ class ReplicationManager:
         """One snapshot pass; returns the number of dirty entries."""
         dirty = self._collect_dirty()
         upd_blob, upd_states = self._updater_blobs()
-        upd_changed = upd_blob != self._last_updater_blob
+        # Serialize the image while still holding the lock: restore()'s
+        # _apply mutates _cache and _last_updater_blob from the recovery
+        # thread while the tick thread runs, so the old unlocked
+        # read-serialize here could msgpack a dict mid-mutation (the
+        # GX-L005 seed finding on _last_updater_blob). Disk I/O stays
+        # outside the lock.
         with self._lock:
+            upd_changed = upd_blob != self._last_updater_blob
             self._cache.update(dirty)
-            have_any = bool(self._cache)
-        if not have_any and not upd_changed:
-            return 0
-        if self.enabled:
-            doc = {
+            if not self._cache and not upd_changed:
+                return 0
+            doc_blob = checkpoint.serialize_blob({
                 "entries": checkpoint.serialize_states(self._cache),
                 "updater": upd_blob,
                 "updater_states": upd_states,
                 "flags": self._flags(),
-            }
-            checkpoint._atomic_write(self.path(),
-                                     checkpoint.serialize_blob(doc))
-            self.num_snapshots += 1
+            }) if self.enabled else None
+            n_total = len(self._cache)
+        if doc_blob is not None:
+            checkpoint._atomic_write(self.path(), doc_blob)
+            with self._lock:
+                self.num_snapshots += 1
             profiler.instant("snapshot.write", cat="recovery",
-                             dirty=len(dirty), total=len(self._cache))
-        self._last_updater_blob = upd_blob
+                             dirty=len(dirty), total=n_total)
+        # only after a successful write (or with no snapshot dir at all)
+        # so a failed _atomic_write retries the updater delta next tick
+        with self._lock:
+            self._last_updater_blob = upd_blob
         if dirty or upd_changed:
             self._push_to_peer(dirty, upd_blob if upd_changed else b"",
                                upd_states if upd_changed else b"")
@@ -387,7 +399,10 @@ class ReplicationManager:
                     upd.set_states(
                         checkpoint.deserialize_states(bytes(upd_states)))
                 s.updater = upd
-                self._last_updater_blob = bytes(upd_blob)
+                # the tick thread compares-and-swaps this under the same
+                # lock; an unlocked write here could lose either update
+                with self._lock:
+                    self._last_updater_blob = bytes(upd_blob)
             except Exception:  # noqa: BLE001 — params beat a dead updater
                 log.exception("updater restore failed; workers must "
                               "re-ship the optimizer")

@@ -70,6 +70,7 @@ from geomx_tpu.kvstore import sharding
 from geomx_tpu.kvstore.base import Command, DATA_INIT
 from geomx_tpu.kvstore.frontier import slice_bytes_from_shape
 from geomx_tpu.ps import base as psbase
+from geomx_tpu.ps import locks
 from geomx_tpu.ps.kv_app import KVPairs, KVServer, KVWorker, ReqMeta
 from geomx_tpu.ps.message import Role
 from geomx_tpu.ps.postoffice import Postoffice
@@ -123,7 +124,7 @@ class _BatchResponder:
         self._srv = srv
         self._left = n
         self._parts: List[KVPairs] = []
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("_BatchResponder._lock")
 
     # this proxy only merges parts into its own buffer; it exists and
     # runs exclusively behind the constructing handler's is_stale fence
@@ -179,7 +180,7 @@ class _KeyState:
     def __init__(self, offset: int):
         # every access to this state goes through this lock (RLock: the
         # pre-init replay path re-enters _global_slice_push)
-        self.lock = threading.RLock()
+        self.lock = locks.make_rlock("_KeyState.lock")
         self.stored: Optional[np.ndarray] = None
         # the aggregate staged for the global tier lives here, NEVER in
         # `stored` — `stored` always holds parameters, so a pull can never
@@ -243,6 +244,8 @@ class _KeyState:
         self.rsp_wire: Dict = {}
 
 
+@locks.guarded_by("_lock", "_states", "_key_total", "_stops_received",
+                  "_stop_forwarded", "_gb_reqs", "_party_nsrv_by_sender")
 class KVStoreDistServer:
     """Runs in every DMLC_ROLE=server process (global server included)."""
 
@@ -277,7 +280,7 @@ class KVStoreDistServer:
 
         # short-lived structural lock (states dict, counters, barriers);
         # data-plane work runs under per-state locks
-        self._lock = threading.RLock()
+        self._lock = locks.make_rlock("KVStoreDistServer._lock")
         # build/load the native kernels BEFORE serving traffic: the lazy
         # first-use build (g++, seconds) would otherwise run inside a
         # push handler while holding a key's state lock

@@ -46,11 +46,11 @@ import logging
 import os
 import statistics
 import tempfile
-import threading
 import time
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from .. import telemetry
+from . import locks
 
 LOG = logging.getLogger("geomx.health")
 
@@ -142,6 +142,8 @@ class _LinkStats:
         setattr(self, attr_mean, mean + _EWMA_ALPHA * d)
 
 
+@locks.guarded_by("_lock", "_links", "_peer_rounds", "_codec_bytes",
+                  "_round")
 class LinkEstimator:
     """Continuous per-link RTT/goodput/loss estimation for one van.
 
@@ -155,7 +157,7 @@ class LinkEstimator:
         self._id_fn = id_fn
         self.tier = tier
         self._window = max(4, int(window))
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("LinkEstimator._lock")
         self._links: Dict[int, _LinkStats] = {}
         self._peer_rounds: Dict[int, int] = {}
         self._codec_bytes: Dict[str, int] = {}
@@ -271,6 +273,9 @@ class LinkEstimator:
 # scheduler-side board
 # ---------------------------------------------------------------------------
 
+@locks.guarded_by("_lock", "version", "_nodes", "_links", "_arrivals",
+                  "_max_round", "_exported_round", "_last_progress",
+                  "_stall_latched", "_events", "_event_counts")
 class ClusterHealthBoard:
     """Aggregates member digests into one versioned board + detectors.
 
@@ -294,7 +299,7 @@ class ClusterHealthBoard:
         self.stall_s = float(stall_s)
         self.min_big_samples = int(min_big_samples)
         self.flightrec = flightrec
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("ClusterHealthBoard._lock")
         self._t0 = time.monotonic()
         self.version = 0
         self._nodes: Dict[int, dict] = {}

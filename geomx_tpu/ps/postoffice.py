@@ -11,13 +11,13 @@ worker or global server).
 from __future__ import annotations
 
 import logging
-import threading
 from typing import Dict, List, Optional, Tuple
 
 from geomx_tpu import config as cfg_mod
 from geomx_tpu import telemetry
 from geomx_tpu.ps import base
 from geomx_tpu.ps import faults
+from geomx_tpu.ps import locks
 from geomx_tpu.ps import shaping
 from geomx_tpu.ps.customer import Customer
 from geomx_tpu.ps.message import Message, Role
@@ -26,6 +26,7 @@ from geomx_tpu.ps.van import Van
 log = logging.getLogger("geomx.postoffice")
 
 
+@locks.guarded_by("_customers_lock", "_customers")
 class Postoffice:
     def __init__(
         self,
@@ -45,6 +46,11 @@ class Postoffice:
         self.num_workers = num_workers
         self.num_servers = num_servers
         _bind_host, _advertise_host = cfg.node_addr()
+        # GEOMX_LOCK_SANITIZER: the witness is process-wide; affirmative-
+        # only (like telemetry.configure below) and BEFORE the Van is
+        # built so every make_lock in its __init__ comes out traced
+        if cfg.lock_sanitizer:
+            locks.enable(True)
         self.van = Van(
             my_role=my_role,
             is_global=is_global,
@@ -108,6 +114,10 @@ class Postoffice:
         # InProcessHiPS) can't have the last default Config turn it off
         telemetry.configure(enabled=True if cfg.telemetry else None,
                             export_dir=cfg.telemetry_dir or None)
+        if cfg.lock_sanitizer:
+            # violations ride the crash flight recorder (kind="race")
+            # next to the wire sanitizer's protocol events
+            locks.witness().attach_flightrec(self.van.flightrec)
         self.van.msg_handler = self._dispatch
         self.van.give_up_handler = self._on_request_undeliverable
         self.van.on_membership = self._fire_membership
@@ -116,7 +126,7 @@ class Postoffice:
         # countdowns; esync prunes its reporter window)
         self._membership_listeners: List = []
         self._customers: Dict[Tuple[int, int], Customer] = {}
-        self._customers_lock = threading.Lock()
+        self._customers_lock = locks.make_lock("Postoffice._customers_lock")
         self._started = False
         # TSEngine: the scheduler of a TS-enabled tier runs the matchmaker
         # (reference: van.cc:1197-1458); members attach a TSNode later
@@ -160,9 +170,15 @@ class Postoffice:
                 self.barrier(base.ALL_GROUP, timeout=barrier_timeout)
             except (TimeoutError, OSError):
                 log.warning("finalize barrier failed; stopping anyway")
+        # snapshot under the lock, stop outside it: Customer.stop
+        # enqueues the shutdown sentinel (a blocking Queue.put), and a
+        # recv thread delivering a late frame may need the registry
+        # lock to route it — stopping under the lock is the exact
+        # blocking-call-under-lock pattern the lock sanitizer flags
         with self._customers_lock:
-            for c in self._customers.values():
-                c.stop()
+            customers = list(self._customers.values())
+        for c in customers:
+            c.stop()
         self.van.stop()
         self._started = False
 

@@ -42,6 +42,7 @@ from collections import OrderedDict, deque
 from typing import TYPE_CHECKING, Deque, Dict, Set, Tuple
 
 from geomx_tpu import telemetry
+from geomx_tpu.ps import locks
 from geomx_tpu.ps.message import Control, Message, Meta
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -52,6 +53,7 @@ log = logging.getLogger("geomx.resender")
 _DEDUP_WINDOW = 100_000  # remembered accepted signatures
 
 
+@locks.guarded_by("_lock", "_outgoing", "_seen", "_seen_order")
 class Resender:
     """Tracks in-flight messages for one van and re-sends unACKed ones."""
 
@@ -73,7 +75,7 @@ class Resender:
         self.max_backoff_s = max_backoff_s
         self.jitter = max(0.0, min(jitter, 0.99))
         self._rng = random.Random(seed)
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("Resender._lock")
         # sig -> (target, message, first_send_monotonic, next_due, num_resends)
         self._outgoing: "OrderedDict[int, Tuple[int, Message, float, float, int]]" = (
             OrderedDict())

@@ -43,13 +43,12 @@ from __future__ import annotations
 import json
 import logging
 import random
-import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from geomx_tpu.ps import base, linkstate
+from geomx_tpu.ps import base, linkstate, locks
 from geomx_tpu.ps.kv_app import KVPairs
 from geomx_tpu.ps.message import Control, Message, Meta
 
@@ -65,6 +64,7 @@ DONE_DEST = -1      # REPLY dest sentinel: "no receiver left"
 _EWMA = 0.3         # throughput smoothing (reference uses per-link EWMA)
 
 
+@locks.guarded_by("_lock", "A", "_push_rounds", "_pull_rounds")
 class TSScheduler:
     """Scheduler-side matchmaking (reference: van.cc:1197-1458).
 
@@ -75,7 +75,7 @@ class TSScheduler:
         self.van = van
         self.num_workers = num_workers
         self.greed = min(max(greed_rate, 0.0), 1.0)
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("TSScheduler._lock")
         # measured throughput matrix A: (src_id, dst_id) -> MB/s EWMA
         self.A: Dict[Tuple[int, int], float] = {}
         # (key, off, ver) -> pending push asker node ids (round completion
@@ -212,6 +212,7 @@ class _Slot:
         self.sent = False    # buffer relayed away / final-pushed this round
 
 
+@locks.guarded_by("_lock", "_slots", "_reports", "_watches")
 class TSNode:
     """Member-side TSEngine endpoint on one tier overlay.
 
@@ -237,8 +238,8 @@ class TSNode:
         # final_push(key, off, total, arr, num_merge, ver): deliver the
         # fully-merged gradient to the server tier (normal sharded push)
         self.final_push = final_push
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
+        self._lock = locks.make_lock("TSNode._lock")
+        self._cv = locks.make_condition(self._lock, name="TSNode._cv")
         self._slots: Dict[Tuple[int, int], _Slot] = {}
         self._reports: List[List[float]] = []
         # (key, off) -> [(min_ver, callback)] async model watches

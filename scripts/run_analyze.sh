@@ -1,6 +1,8 @@
 #!/bin/bash
-# geomx-lint from any cwd, all four passes: lock, traced-code,
-# config-drift and wire-protocol (GX-P3xx) analysis.
+# geomx-lint from any cwd, all five analysis families: lock/lock-model
+# (GX-L, concurrency + lockmodel passes), traced-code (GX-J),
+# config-drift (GX-C), wire-protocol (GX-P3xx) and metrics-funnel
+# (GX-M4xx) analysis.
 # Flags pass through, e.g.:  scripts/run_analyze.sh --passes traced --json
 # See docs/static-analysis.md for the rule catalogue + baseline workflow.
 set -euo pipefail

@@ -103,22 +103,31 @@ run_case partition \
 # pipelined round (async chunked push_pull, P3 slicing) under drops,
 # reordering and duplicates: chunk responses land out of order and some
 # retransmit; training must still complete with the same convergence.
-# The wire sanitizer rides along on this case (no kills, so membership
-# never churns): every van checks requests ack exactly once, countdowns
-# drain, epochs stay monotone — any violation fails the case below.
-export GEOMX_OVERLAP=1 P3_SLICE_BYTES=131072 GEOMX_WIRE_SANITIZER=1
+# The wire AND lock sanitizers ride along on this case (no kills, so
+# membership never churns): every van checks requests ack exactly once,
+# countdowns drain, epochs stay monotone, and every traced lock feeds
+# the witness (order inversions, blocking under a lock, @guarded_by
+# locksets) — any violation of either fails the case below.
+export GEOMX_OVERLAP=1 P3_SLICE_BYTES=131072 GEOMX_WIRE_SANITIZER=1 \
+       GEOMX_LOCK_SANITIZER=1
 run_case overlap \
   '[{"type": "drop", "p": 0.1},
     {"type": "reorder", "window": 4},
     {"type": "dup", "p": 0.05}]' \
   9790 "$@"
-unset GEOMX_OVERLAP P3_SLICE_BYTES GEOMX_WIRE_SANITIZER
+unset GEOMX_OVERLAP P3_SLICE_BYTES GEOMX_WIRE_SANITIZER \
+      GEOMX_LOCK_SANITIZER
 # launch_hips overwrites /tmp/hips_*.log per case, so these are the
 # overlap run's logs
 if grep -l "WIRE-SANITIZER VIOLATION" /tmp/hips_*.log 2>/dev/null; then
   echo "=== chaos[overlap] FAILED: wire-sanitizer violations (see logs above) ==="
   # the sanitizer also triggered flight-recorder dumps — collect them
   collect_artifacts overlap-sanitizer "$LAST_FDIR" "$LAST_TDIR"
+  FAILED=1
+fi
+if grep -l "LOCK-SANITIZER VIOLATION" /tmp/hips_*.log 2>/dev/null; then
+  echo "=== chaos[overlap] FAILED: lock-sanitizer violations (see logs above) ==="
+  collect_artifacts overlap-locksan "$LAST_FDIR" "$LAST_TDIR"
   FAILED=1
 fi
 

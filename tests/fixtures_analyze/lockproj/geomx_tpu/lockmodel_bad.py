@@ -1,0 +1,92 @@
+"""Seeded lock-model violations (GX-L005/L006) — analyzed, never
+imported — next to the clean counterparts that must stay clean."""
+
+import threading
+
+from geomx_tpu.ps import locks
+
+
+class Bad005:
+    """GX-L005: ``count`` written with no lock held from two thread
+    roots (the spawned ``_loop`` plus the external caller of ``bump``)
+    and never declared ``@guarded_by``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        self.count += 1                    # unlocked, thread root _loop
+
+    def bump(self):
+        self.count += 1                    # unlocked, root <caller>
+
+
+@locks.guarded_by("_lock", "count")
+class Good005Declared:
+    """Same write pattern, but the field is declared: the racy writes
+    are the runtime lockset checker's business, not GX-L005's."""
+
+    def __init__(self):
+        self._lock = locks.make_lock("Good005Declared._lock")
+        self.count = 0
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        self.count += 1
+
+    def bump(self):
+        self.count += 1
+
+
+class Good005Locked:
+    """Same roots, but every write holds the lock: clean."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        with self._lock:
+            self.count = 0
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        with self._lock:
+            self.count += 1
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+
+
+class Bad006:
+    """GX-L006: ``Condition.wait()`` with an ``if`` instead of a
+    ``while`` predicate loop."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._ready = False
+
+    def take(self):
+        with self._cv:
+            if not self._ready:
+                self._cv.wait()            # spurious wakeup slips through
+
+
+class Good006:
+    """The two sanctioned wait shapes: a while predicate loop, and
+    ``wait_for`` (which carries its own loop)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._ready = False
+
+    def take(self):
+        with self._cv:
+            while not self._ready:
+                self._cv.wait()
+
+    def take_for(self):
+        with self._cv:
+            self._cv.wait_for(lambda: self._ready)

@@ -23,10 +23,12 @@ import pytest
 
 from tools.analyze import (DEFAULT_BASELINE, load_baseline, load_sources,
                            run_all, run_concurrency, run_config_drift,
-                           run_metrics, run_protocol, run_traced,
-                           save_baseline, split_by_baseline,
-                           write_binmeta_lock)
+                           run_lockmodel, run_metrics, run_protocol,
+                           run_traced, save_baseline, split_by_baseline,
+                           write_binmeta_lock, write_lock_model)
 from tools.analyze.config_drift import _expand_doc_shorthand
+from tools.analyze.lockmodel import (extract_lock_model, lockmodel_lock_path,
+                                     model_fingerprint)
 from tools.analyze.protocol import (binmeta_lock_path, extract_meta_schema,
                                     meta_schema_fingerprint)
 
@@ -302,6 +304,71 @@ def test_committed_binmeta_lock_matches_tree():
     lock = json.loads(binmeta_lock_path(REPO).read_text(encoding="utf-8"))
     assert lock["version"] == version
     assert lock["fingerprint"] == meta_schema_fingerprint(fields)
+
+
+# ---------------------------------------------------------------------------
+# lockmodel pass (GX-L005..L007)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def lockmodel_findings():
+    root = FIXTURES / "lockproj"
+    return run_lockmodel(load_sources([root / "geomx_tpu"], root), root)
+
+
+def test_unguarded_multiroot_write_fires(lockmodel_findings):
+    hits = _by_rule(lockmodel_findings, "GX-L005")
+    assert [h.symbol for h in hits] == ["lockmodel_bad.Bad005.count"]
+    # both racing roots are named: the spawned loop and the external
+    # caller; the @guarded_by-declared and lock-holding counterparts
+    # stay clean
+    assert "_loop" in hits[0].detail and "<caller>" in hits[0].detail
+
+
+def test_wait_outside_while_fires(lockmodel_findings):
+    hits = _by_rule(lockmodel_findings, "GX-L006")
+    assert [h.symbol for h in hits] == ["lockmodel_bad.Bad006.take"]
+    assert hits[0].detail == "_cv"
+    # the while-predicate loop and wait_for() shapes stay clean
+
+
+def test_lock_model_missing_and_drift(tmp_path):
+    src = FIXTURES / "lockproj" / "geomx_tpu" / "lockmodel_bad.py"
+    (tmp_path / "geomx_tpu").mkdir()
+    fx = tmp_path / "geomx_tpu" / "lockmodel_bad.py"
+    fx.write_text(src.read_text(encoding="utf-8"), encoding="utf-8")
+    sources = load_sources([tmp_path / "geomx_tpu"], tmp_path)
+
+    # no lock file at all -> lock-missing
+    hits = _by_rule(run_lockmodel(sources, tmp_path), "GX-L007")
+    assert [h.detail for h in hits] == ["lock-missing"]
+
+    # freezing the model makes the pass clean
+    write_lock_model(sources, tmp_path)
+    assert _by_rule(run_lockmodel(sources, tmp_path), "GX-L007") == []
+
+    # moving a @guarded_by declaration to another lock without
+    # refreshing the frozen model -> model-changed
+    fx.write_text(fx.read_text(encoding="utf-8").replace(
+        'locks.guarded_by("_lock", "count")',
+        'locks.guarded_by("_cv", "count")'), encoding="utf-8")
+    sources = load_sources([tmp_path / "geomx_tpu"], tmp_path)
+    hits = _by_rule(run_lockmodel(sources, tmp_path), "GX-L007")
+    assert [h.detail for h in hits] == ["model-changed"]
+    assert hits[0].symbol == "geomx_tpu/lockmodel_bad.py"
+
+
+def test_committed_lock_model_matches_tree():
+    """The real lock model is in sync with the tree: the runtime
+    witness and GX-L007 read the same frozen declarations."""
+    import json
+    model = extract_lock_model(load_sources([REPO / "geomx_tpu"], REPO))
+    doc = json.loads(
+        lockmodel_lock_path(REPO).read_text(encoding="utf-8"))
+    files = doc["files"]
+    assert sorted(files) == sorted(model)
+    for rel, entry in model.items():
+        assert files[rel]["fingerprint"] == model_fingerprint(entry), rel
 
 
 # ---------------------------------------------------------------------------

@@ -282,6 +282,7 @@ def _gate_stub(shaper=None, injector=None):
     stub._rng = None
     stub.verbose = False
     stub.num_data_recv = 0
+    stub._stats_lock = threading.Lock()
     return stub
 
 

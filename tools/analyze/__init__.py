@@ -1,6 +1,6 @@
 """geomx-lint: project-native static analysis for geomx_tpu.
 
-Four AST passes over the tree (no imports of the analyzed code, no
+AST passes over the tree (no imports of the analyzed code, no
 process spawns — safe to run anywhere, including CI on a box with no
 accelerator):
 
@@ -19,6 +19,11 @@ accelerator):
 - **metrics** (GX-M4xx): raw ``profiler.instant``/``profiler.counter``
   calls outside the telemetry funnel (geomx_tpu/telemetry.py) — events
   the metrics registry would silently miss.
+- **lockmodel** (GX-L005..L007): the geomx-racecheck shared model —
+  lock inventory + ``@guarded_by`` declarations frozen into
+  ``tools/analyze/locks.lock.json`` (drift fails GX-L007, the runtime
+  witness in ``geomx_tpu/ps/locks.py`` loads the same json), unguarded
+  multi-thread-root writes, ``Condition.wait`` outside a while loop.
 
 Run ``python -m tools.analyze`` from the repo root; see
 docs/static-analysis.md for the rule catalogue, baseline workflow and
@@ -35,6 +40,7 @@ from .core import (Finding, SEV_ERROR, SEV_WARNING, SourceFile,
                    save_baseline, sort_findings, split_by_baseline)
 from .concurrency import run_concurrency
 from .config_drift import run_config_drift
+from .lockmodel import run_lockmodel, write_lock_model
 from .metrics import run_metrics
 from .protocol import run_protocol, write_binmeta_lock
 from .traced import run_traced
@@ -42,7 +48,8 @@ from .traced import run_traced
 __all__ = [
     "Finding", "SEV_ERROR", "SEV_WARNING", "SourceFile",
     "run_concurrency", "run_traced", "run_config_drift", "run_protocol",
-    "run_metrics", "run_all", "write_binmeta_lock",
+    "run_metrics", "run_lockmodel", "run_all",
+    "write_binmeta_lock", "write_lock_model",
     "load_baseline", "save_baseline", "split_by_baseline",
     "sort_findings", "DEFAULT_BASELINE",
 ]
@@ -55,6 +62,7 @@ PASSES = {
     "config-drift": run_config_drift,
     "protocol": run_protocol,
     "metrics": lambda sources, root: run_metrics(sources),
+    "lockmodel": run_lockmodel,
 }
 
 

@@ -4,7 +4,10 @@ Exit codes: 0 = no findings beyond the baseline; 1 = new findings;
 2 = usage error. ``--update-baseline`` rewrites the committed baseline
 to exactly the current findings (do this after fixing or accepting);
 ``--prune-baseline`` drops only the stale entries; ``--update-binmeta-
-lock`` refreshes the wire-schema lock after a BINMETA_VERSION bump."""
+lock`` refreshes the wire-schema lock after a BINMETA_VERSION bump;
+``--update-lock-model`` refreshes the geomx-racecheck lock model
+(tools/analyze/locks.lock.json) after a deliberate lock/@guarded_by
+change."""
 
 from __future__ import annotations
 
@@ -15,14 +18,15 @@ from pathlib import Path
 
 from . import (DEFAULT_BASELINE, PASSES, load_baseline, load_sources,
                run_all, save_baseline, split_by_baseline,
-               write_binmeta_lock)
+               write_binmeta_lock, write_lock_model)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.analyze",
-        description="geomx-lint: lock, traced-code, config-drift and "
-                    "protocol static analysis (docs/static-analysis.md)")
+        description="geomx-lint: lock/lock-model, traced-code, "
+                    "config-drift, protocol and metrics static analysis "
+                    "(docs/static-analysis.md)")
     ap.add_argument("paths", nargs="*", default=None,
                     help="files/dirs to analyze (default: geomx_tpu/)")
     ap.add_argument("--root", default=".",
@@ -43,8 +47,13 @@ def main(argv=None) -> int:
     ap.add_argument("--update-binmeta-lock", action="store_true",
                     help="refresh tools/analyze/binmeta.lock.json from "
                          "the current Meta wire schema")
+    ap.add_argument("--update-lock-model", action="store_true",
+                    help="refresh tools/analyze/locks.lock.json from "
+                         "the current lock inventory + @guarded_by "
+                         "declarations")
     ap.add_argument("--json", action="store_true",
-                    help="machine-readable output")
+                    help="machine-readable findings (rule, file, line, "
+                         "fingerprint) for CI / chaos-matrix diffing")
     args = ap.parse_args(argv)
 
     root = Path(args.root)
@@ -58,6 +67,11 @@ def main(argv=None) -> int:
     if args.update_binmeta_lock:
         lock = write_binmeta_lock(load_sources(paths, root), root)
         print(f"binmeta lock updated -> {lock}")
+        return 0
+
+    if args.update_lock_model:
+        lock = write_lock_model(load_sources(paths, root), root)
+        print(f"lock model updated -> {lock}")
         return 0
 
     findings = run_all(paths, root, passes)
@@ -85,9 +99,13 @@ def main(argv=None) -> int:
     new, accepted = split_by_baseline(findings, baseline)
 
     if args.json:
+        # fingerprint included so CI / the chaos matrix can diff runs
+        # by identity instead of grepping rendered stderr lines
         print(json.dumps({
-            "new": [vars(f) for f in new],
-            "accepted": [vars(f) for f in accepted],
+            "new": [{**vars(f), "fingerprint": f.fingerprint}
+                    for f in new],
+            "accepted": [{**vars(f), "fingerprint": f.fingerprint}
+                         for f in accepted],
         }, indent=1))
     else:
         for f in new:

@@ -37,6 +37,22 @@ _LOCK_CTORS = {
     "Lock": "Lock",
     "RLock": "RLock",
     "Condition": "Condition",
+    # geomx-racecheck traced drop-ins (geomx_tpu/ps/locks.py): the
+    # factories return raw primitives when GEOMX_LOCK_SANITIZER=0, so
+    # statically they ARE the lock they wrap. make_condition's first
+    # positional arg is the underlying lock, exactly like Condition's.
+    "locks.make_lock": "Lock",
+    "make_lock": "Lock",
+    "locks.make_rlock": "RLock",
+    "make_rlock": "RLock",
+    "locks.make_condition": "Condition",
+    "make_condition": "Condition",
+    "locks.TracedLock": "Lock",
+    "TracedLock": "Lock",
+    "locks.TracedRLock": "RLock",
+    "TracedRLock": "RLock",
+    "locks.TracedCondition": "Condition",
+    "TracedCondition": "Condition",
 }
 _THREAD_CTORS = {"threading.Thread", "Thread"}
 _QUEUE_CTORS = {"queue.Queue", "Queue", "queue.SimpleQueue", "SimpleQueue",

@@ -1,0 +1,346 @@
+"""Lock-model pass: the shared model behind geomx-racecheck.
+
+Extracts the concurrency model from the AST — every class's lock
+inventory (raw ``threading`` primitives AND the traced
+``locks.make_lock``/``make_rlock``/``make_condition`` factories) plus
+its ``@guarded_by("lock", "field", ...)`` declarations — and freezes it
+into ``tools/analyze/locks.lock.json``, the same lock-file workflow as
+the binary-meta schema (GX-P306): drift fails GX-L007 and
+``python -m tools.analyze --update-lock-model`` moves the lock. The
+runtime witness (``geomx_tpu/ps/locks.py``) loads the SAME json and
+cross-checks every runtime ``@guarded_by`` registration against it, so
+the static declarations and the runtime locksets cannot diverge.
+
+Rules
+-----
+GX-L005 (warning) a ``self.<field>`` written with no lock held from two
+                  or more distinct thread roots — a method spawned as a
+                  thread target (``Thread(target=self.m)`` /
+                  ``self._spawn(self.m)`` / ``run``) or anything it
+                  calls, plus the external-caller root — with no
+                  ``@guarded_by`` declaration. The untyped cousin of
+                  GX-L002: no guarding lock exists anywhere, so the
+                  write-side race is invisible to the inversion rules.
+GX-L006 (error)   ``Condition.wait()`` outside a ``while`` predicate
+                  loop — wakeups are spurious-wakeup- and missed-
+                  signal-prone unless re-checked in a loop.
+                  ``wait_for`` carries its own predicate loop and is
+                  exempt.
+GX-L007 (error)   the extracted lock model of an analyzed file drifted
+                  from ``tools/analyze/locks.lock.json`` (entry
+                  missing, stale, or fingerprint changed). After a
+                  deliberate change: ``--update-lock-model`` and commit
+                  the lock diff.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .concurrency import _ScopeInfo, _collect_locks, _scan_method
+from .core import (Finding, SEV_ERROR, SEV_WARNING, SourceFile, call_name,
+                   const_str)
+
+_GUARDED_DECOS = {"guarded_by", "locks.guarded_by"}
+_EXTERNAL_ROOT = "<caller>"
+
+
+# ---------------------------------------------------------------------------
+# model extraction
+# ---------------------------------------------------------------------------
+
+def _guarded_map(cls: ast.ClassDef) -> Dict[str, str]:
+    """``@guarded_by("lock", "f1", "f2")`` decorators -> {field: lock}."""
+    out: Dict[str, str] = {}
+    for deco in cls.decorator_list:
+        if not isinstance(deco, ast.Call):
+            continue
+        if call_name(deco.func) not in _GUARDED_DECOS or not deco.args:
+            continue
+        lock = const_str(deco.args[0])
+        if lock is None:
+            continue
+        for arg in deco.args[1:]:
+            field = const_str(arg)
+            if field is not None:
+                out[field] = lock
+    return out
+
+
+def _thread_entries(cls: ast.ClassDef) -> Set[str]:
+    """Methods handed to a thread: ``Thread(target=self.m)``, a
+    ``*spawn*``-named helper's ``self.m`` argument, or ``run``."""
+    entries: Set[str] = set()
+    methods = {n.name for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    def self_method(node: ast.AST) -> Optional[str]:
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self" and node.attr in methods):
+            return node.attr
+        return None
+
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        cname = call_name(node.func)
+        if cname.rsplit(".", 1)[-1] == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    m = self_method(kw.value)
+                    if m:
+                        entries.add(m)
+        elif "spawn" in cname.rsplit(".", 1)[-1].lower():
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                m = self_method(arg)
+                if m:
+                    entries.add(m)
+    if "run" in methods and entries | {"run"} != {"run"}:
+        # a class that both spawns threads and defines run(): run is a
+        # plausible extra entry; a lone run() without spawning is not
+        entries.add("run")
+    return entries
+
+
+def _class_scope(src: SourceFile, cls: ast.ClassDef) -> _ScopeInfo:
+    modname = Path(src.rel).stem
+    scope = _ScopeInfo(f"{modname}.{cls.name}", "self.")
+    methods = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    _collect_locks(scope, methods, prefix_self=True)
+    for m in methods:
+        _scan_method(scope, m.name, m)
+    return scope
+
+
+def extract_lock_model(sources: Sequence[SourceFile]
+                       ) -> Dict[str, Dict[str, dict]]:
+    """rel path -> {"classes": {name: {"locks": {attr: kind},
+    "guarded": {field: lock}}}} for files with any lock content."""
+    model: Dict[str, Dict[str, dict]] = {}
+    for src in sources:
+        if src.tree is None:
+            continue
+        classes: Dict[str, dict] = {}
+        for cls in [n for n in ast.walk(src.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            scope = _class_scope(src, cls)
+            guarded = _guarded_map(cls)
+            if not scope.locks and not guarded:
+                continue
+            classes[cls.name] = {
+                "locks": {name: d.kind
+                          for name, d in sorted(scope.locks.items())},
+                "guarded": dict(sorted(guarded.items())),
+            }
+        if classes:
+            model[src.rel] = {"classes": classes}
+    return model
+
+
+def model_fingerprint(entry: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(entry, sort_keys=True).encode("utf-8")).hexdigest()[:16]
+
+
+def lockmodel_lock_path(root: Path) -> Path:
+    return Path(root) / "tools" / "analyze" / "locks.lock.json"
+
+
+def write_lock_model(sources: Sequence[SourceFile], root: Path) -> Path:
+    """Freeze the current model — the ``--update-lock-model`` action."""
+    model = extract_lock_model(sources)
+    doc = {
+        "version": 1,
+        "files": {
+            rel: {"fingerprint": model_fingerprint(entry), **entry}
+            for rel, entry in sorted(model.items())
+        },
+    }
+    path = lockmodel_lock_path(root)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(doc, indent=1, sort_keys=True) + "\n",
+                    encoding="utf-8")
+    return path
+
+
+# ---------------------------------------------------------------------------
+# GX-L005: unguarded multi-root writes
+# ---------------------------------------------------------------------------
+
+def _reachable(scope: _ScopeInfo, roots: Set[str]) -> Dict[str, Set[str]]:
+    """method -> set of entry roots that (transitively) reach it."""
+    reach: Dict[str, Set[str]] = {}
+    for root in roots:
+        stack, seen = [root], set()
+        while stack:
+            m = stack.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            reach.setdefault(m, set()).add(root)
+            for callee, _held, _line in scope.calls.get(m, ()):
+                for cand in (callee, f"{m}.<locals>.{callee}"):
+                    if cand in scope.calls or cand in scope.direct_acquires:
+                        stack.append(cand)
+    return reach
+
+
+def _l005_findings(src: SourceFile, cls: ast.ClassDef, scope: _ScopeInfo,
+                   guarded: Dict[str, str],
+                   entries: Set[str]) -> List[Finding]:
+    if not entries:
+        return []
+    out: List[Finding] = []
+    reach = _reachable(scope, entries)
+    for attr, writes in sorted(scope.unguarded_writes.items()):
+        if attr in guarded or attr in scope.locks \
+                or attr in scope.threads or attr in scope.queues:
+            continue
+        if scope.guarded_writes.get(attr):
+            continue  # mixed guarded/unguarded is GX-L002's finding
+        roots: Set[str] = set()
+        for w in writes:
+            roots |= reach.get(w.method, {_EXTERNAL_ROOT})
+        if len(roots) < 2 or not (roots & entries):
+            continue
+        w = writes[0]
+        out.append(Finding(
+            "GX-L005", SEV_WARNING, src.rel, w.line,
+            symbol=f"{scope.qualname}.{attr}",
+            detail=":".join(sorted(roots)),
+            message=(f"{scope.qualname}.{attr} is written with no lock "
+                     f"held from {len(roots)} thread roots "
+                     f"({', '.join(sorted(roots))}) and carries no "
+                     f"@guarded_by declaration — racy write; guard it "
+                     f"or declare the lock")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GX-L006: Condition.wait outside a while loop
+# ---------------------------------------------------------------------------
+
+def _l006_findings(src: SourceFile, cls: ast.ClassDef,
+                   scope: _ScopeInfo) -> List[Finding]:
+    conds = {name for name, d in scope.locks.items()
+             if d.kind == "Condition"}
+    if not conds:
+        return []
+    out: List[Finding] = []
+
+    def visit(node: ast.AST, method: str, in_while: bool) -> None:
+        if isinstance(node, ast.While):
+            for child in ast.iter_child_nodes(node):
+                visit(child, method, True)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and method is not None:
+            visit_method(node, f"{method}.<locals>.{node.name}")
+            return
+        if isinstance(node, ast.Call) and not in_while:
+            name = call_name(node.func)
+            if name.startswith("self.") and name.endswith(".wait"):
+                attr = name[len("self."):-len(".wait")]
+                if attr in conds:
+                    out.append(Finding(
+                        "GX-L006", SEV_ERROR, src.rel, node.lineno,
+                        symbol=f"{scope.qualname}.{method}", detail=attr,
+                        message=(f"Condition {attr!r}.wait() outside a "
+                                 f"while predicate loop in {method} — "
+                                 f"spurious wakeups and missed signals "
+                                 f"break this; loop on the predicate or "
+                                 f"use wait_for()")))
+        for child in ast.iter_child_nodes(node):
+            visit(child, method, in_while)
+
+    def visit_method(fn: ast.AST, name: str) -> None:
+        for st in fn.body:
+            visit(st, name, False)
+
+    for m in [n for n in cls.body
+              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+        visit_method(m, m.name)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GX-L007: lock-file drift
+# ---------------------------------------------------------------------------
+
+def _l007_findings(model: Dict[str, dict], root: Path) -> List[Finding]:
+    if not model:
+        return []
+    lock_path = lockmodel_lock_path(root)
+    rel_lock = "tools/analyze/locks.lock.json"
+    if not lock_path.exists():
+        return [Finding(
+            "GX-L007", SEV_ERROR, rel_lock, 0, symbol="locks.lock.json",
+            detail="lock-missing",
+            message=("lock model file is missing — freeze the current "
+                     "model with `python -m tools.analyze "
+                     "--update-lock-model` and commit it"))]
+    try:
+        doc = json.loads(lock_path.read_text(encoding="utf-8"))
+    except ValueError:
+        return [Finding(
+            "GX-L007", SEV_ERROR, rel_lock, 0, symbol="locks.lock.json",
+            detail="lock-unreadable",
+            message="lock model file is not valid json — regenerate it "
+                    "with --update-lock-model")]
+    files = doc.get("files", {})
+    out: List[Finding] = []
+    for rel, entry in sorted(model.items()):
+        frozen = files.get(rel)
+        if frozen is None:
+            out.append(Finding(
+                "GX-L007", SEV_ERROR, rel, 0, symbol=rel,
+                detail="entry-missing",
+                message=(f"{rel} now carries locks/@guarded_by but has "
+                         f"no entry in {rel_lock} — run "
+                         f"--update-lock-model and commit the diff")))
+        elif frozen.get("fingerprint") != model_fingerprint(entry):
+            out.append(Finding(
+                "GX-L007", SEV_ERROR, rel, 0, symbol=rel,
+                detail="model-changed",
+                message=(f"lock model of {rel} drifted from {rel_lock} "
+                         f"(lock inventory or @guarded_by declarations "
+                         f"changed) — review, then --update-lock-model "
+                         f"and commit the diff")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+def run_lockmodel(sources: Sequence[SourceFile],
+                  root: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    model: Dict[str, dict] = {}
+    for src in sources:
+        if src.tree is None:
+            continue
+        classes: Dict[str, dict] = {}
+        for cls in [n for n in ast.walk(src.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            scope = _class_scope(src, cls)
+            guarded = _guarded_map(cls)
+            if scope.locks or guarded:
+                classes[cls.name] = {
+                    "locks": {name: d.kind
+                              for name, d in sorted(scope.locks.items())},
+                    "guarded": dict(sorted(guarded.items())),
+                }
+            entries = _thread_entries(cls)
+            findings += _l005_findings(src, cls, scope, guarded, entries)
+            findings += _l006_findings(src, cls, scope)
+        if classes:
+            model[src.rel] = {"classes": classes}
+    findings += _l007_findings(model, Path(root))
+    return findings

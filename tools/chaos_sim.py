@@ -139,7 +139,7 @@ def main():
         else (16384 if args.health else 65536)
 
     from geomx_tpu.optimizer import SGD
-    from geomx_tpu.ps import base, linkstate, sanitizer
+    from geomx_tpu.ps import base, linkstate, locks, sanitizer
     from geomx_tpu.simulate import InProcessHiPS
 
     n = args.parties
@@ -157,6 +157,7 @@ def main():
                     if args.health
                     else _fault_plan(thin_ids, flapper, args.seed)),
         wire_sanitizer=True,
+        lock_sanitizer=True,
         # drops/flaps heal through the resender; the deadline outlives
         # the longest flap window by a wide margin
         resend=True, resend_timeout_ms=500, resend_deadline_s=120.0,
@@ -196,6 +197,8 @@ def main():
 
     trap = _MarkerTrap(sanitizer.MARKER)
     logging.getLogger("geomx.sanitizer").addHandler(trap)
+    ltrap = _MarkerTrap(locks.MARKER)
+    logging.getLogger("geomx.locks").addHandler(ltrap)
     htrap = _MarkerTrap(linkstate.MARKER, level=logging.WARNING)
     logging.getLogger("geomx.health").addHandler(htrap)
 
@@ -250,6 +253,11 @@ def main():
     if trap.hits:
         print(f"FAILED: {len(trap.hits)} wire-sanitizer violation(s):")
         for h in trap.hits[:10]:
+            print("  " + h)
+        ok = False
+    if ltrap.hits:
+        print(f"FAILED: {len(ltrap.hits)} lock-sanitizer violation(s):")
+        for h in ltrap.hits[:10]:
             print("  " + h)
         ok = False
 
