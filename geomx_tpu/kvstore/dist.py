@@ -41,6 +41,7 @@ from geomx_tpu import profiler
 from geomx_tpu import telemetry
 from geomx_tpu.compression.device import WireCodec, decode_wire
 from geomx_tpu.kvstore import sharding
+from geomx_tpu.kvstore.controller import TransportController
 from geomx_tpu.kvstore.base import Command, DATA_INIT, KVStore, _sum_values
 from geomx_tpu.kvstore.frontier import (RoundFuture, give_up_exc,
                                         plan_chunks,
@@ -151,6 +152,15 @@ class KVStoreDist(KVStore):
         # per-chunk codecs for push_pull_async / push_pull_bsc_batch_async
         # with 2-bit error-feedback residuals keyed per (key, offset)
         self._wire = WireCodec.from_config(c)
+        # self-tuning transport (GEOMX_TRANSPORT_CONTROLLER;
+        # kvstore/controller.py): per-round plan over this van's OWN
+        # link estimates — per-server chunk codec + live-BDP chunk
+        # budget for push_pull_async. Off (the default) leaves every
+        # path below bit-for-bit untouched.
+        self._controller = None
+        if c.transport_controller and c.health:
+            self._controller = TransportController.for_van(
+                self.po.van, c, tier="local")
 
         # startup barrier (reference: kvstore_dist.h:64), then the
         # creation-time command protocol (reference: kvstore.cc:56-63).
@@ -630,8 +640,20 @@ class KVStoreDist(KVStore):
             if not (isinstance(o, np.ndarray) and o.flags.writeable):
                 raise TypeError(
                     "push_pull_async requires writable numpy ndarrays")
+        rid = self._begin_round()
+        # self-tuning transport: one plan per round, computed from the
+        # freshest link estimates. It can re-size the chunk budget to
+        # the measured BDP (explicit slice_bytes= still wins — operator
+        # intent) and override the per-server codec below. None when
+        # the controller is off: everything stays bit-for-bit static.
+        tplan = (self._controller.plan(rid)
+                 if self._controller is not None else None)
         sb = self.cfg.p3_slice_bytes if slice_bytes is None else slice_bytes
-        wire_on = self._wire.enabled()
+        if tplan is not None and slice_bytes is None \
+                and tplan.slice_bytes > 0:
+            sb = tplan.slice_bytes
+        wire_on = self._wire.enabled() \
+            or (tplan is not None and tplan.has_codecs())
         # layer-ordered (key, shard, flat-segment) entry list
         entries = []
         for k, v in zip(keys, values):
@@ -651,8 +673,8 @@ class KVStoreDist(KVStore):
             list(range(len(entries))),
             [int(e[2].size) * 4 for e in entries],
             sb, base_priority=priority,
-            codec_for=self._wire.chunk_codec if wire_on else None)
-        rid = self._begin_round()
+            codec_for=self._wire.chunk_codec
+            if self._wire.enabled() else None)
         fut = RoundFuture(keys, consume=self._consume_errors,
                           max_retries=self.cfg.chunk_retries,
                           on_abort=self._abort_round)
@@ -666,17 +688,25 @@ class KVStoreDist(KVStore):
         for ch in chunks:
             per_server: Dict[int, KVPairs] = {}
             server_keys: Dict[int, List[int]] = {}
+            ch_elems = sum(int(entries[ei][2].size) for ei in ch.items)
             for ei in ch.items:
                 k, sh, seg = entries[ei]
+                # per-(chunk, server) codec: the transport plan's
+                # per-peer assignment (fat links fp16, thin 2bit/mpq)
+                # overrides the chunk's static tag; servers decode
+                # tag-driven, so no protocol change rides with this
+                codec = ch.codec if tplan is None else tplan.wire_tag(
+                    psbase.server_rank_to_id(sh.server_rank),
+                    ch.codec, ch_elems)
                 kvs = per_server.setdefault(
-                    sh.server_rank, KVPairs(compr=ch.codec))
+                    sh.server_rank, KVPairs(compr=codec))
                 kvs.keys.append(k)
-                if ch.codec:
+                if kvs.compr:
                     # encode ONCE at message build: chunk retries below
                     # resend these bytes, so the 2-bit residual for
                     # (key, offset) drains exactly once per round
                     wv, aux, _tag = self._wire.encode(
-                        ch.codec, seg, (k, sh.offset))
+                        kvs.compr, seg, (k, sh.offset))
                     kvs.vals.append(wv)
                     # always append (None for fp16): the server's push
                     # decompress indexes aux[i] positionally

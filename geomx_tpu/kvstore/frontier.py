@@ -36,8 +36,8 @@ from typing import Callable, Dict, Iterable, List, Optional, Sequence
 from geomx_tpu import telemetry
 
 __all__ = ["give_up_exc", "Chunk", "plan_chunks", "auto_slice_bytes",
-           "slice_bytes_from_shape", "RoundFuture", "RoundAborted",
-           "WorkerLostError"]
+           "slice_bytes_from_shape", "slice_bytes_from_links",
+           "RoundFuture", "RoundAborted", "WorkerLostError"]
 
 
 class RoundAborted(RuntimeError):
@@ -133,6 +133,40 @@ def slice_bytes_from_shape(cfg) -> int:
     if worst is None:
         return 0
     return auto_slice_bytes(*worst)
+
+
+def slice_bytes_from_links(links: Iterable[Sequence[float]],
+                           min_bytes: int = 65536,
+                           max_bytes: int = 4 << 20,
+                           rtt_floor_ms: float = 0.0) -> int:
+    """Chunk budget from LIVE link estimates: the worst (highest-BDP)
+    measured ``(rtt_ms, bw_mbps)`` pair through
+    :func:`auto_slice_bytes` — the second slice-budget source, fed by
+    the transport controller from ``LinkEstimator`` digests (or a
+    ``ClusterHealthBoard`` render) instead of the declared shape plan.
+
+    Slice-budget precedence, as resolved by the consumers:
+
+    1. an explicit ``P3_SLICE_BYTES > 0`` (or a per-call
+       ``slice_bytes=``) always wins — operator intent;
+    2. the live estimate (this function, via the
+       ``GEOMX_TRANSPORT_CONTROLLER`` plan) overrides the shape-plan
+       auto value once real measurements exist;
+    3. ``P3_SLICE_BYTES=-1`` resolves against the declared plan
+       (:func:`slice_bytes_from_shape`) until then;
+    4. otherwise 0 — the single-chunk round-5 wire.
+
+    Links with ``rtt_ms`` under ``rtt_floor_ms`` (or without a
+    bandwidth estimate yet) contribute nothing: a loopback BDP would
+    shrink chunking pointlessly. Returns 0 when no link qualifies —
+    callers keep their configured budget."""
+    best = 0
+    for rtt_ms, bw_mbps in links:
+        if rtt_ms < rtt_floor_ms or bw_mbps <= 0:
+            continue
+        best = max(best, auto_slice_bytes(rtt_ms, bw_mbps,
+                                          min_bytes, max_bytes))
+    return best
 
 
 def plan_chunks(items: Sequence, sizes_bytes: Sequence[int],

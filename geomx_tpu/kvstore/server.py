@@ -68,6 +68,7 @@ from geomx_tpu.compression import make_compressor
 from geomx_tpu.compression.device import WireCodec
 from geomx_tpu.kvstore import sharding
 from geomx_tpu.kvstore.base import Command, DATA_INIT
+from geomx_tpu.kvstore.controller import TransportController
 from geomx_tpu.kvstore.frontier import slice_bytes_from_shape
 from geomx_tpu.ps import base as psbase
 from geomx_tpu.ps import locks
@@ -311,6 +312,15 @@ class KVStoreDistServer:
         self._wire = WireCodec.from_config(c)
         self._wire_wan = (WireCodec.from_config(c, policy=c.wire_codec_wan)
                           if c.wire_codec_wan else None)
+        # self-tuning transport on the WAN leg (GEOMX_TRANSPORT_CONTROLLER;
+        # kvstore/controller.py): a party server plans the forward codec
+        # per round from its global van's OWN link estimates — the leg
+        # where links are genuinely heterogeneous. None when off: the
+        # static _wan_wire_tag precedence is untouched.
+        self._transport = None
+        if c.transport_controller and c.health and self.has_global_tier:
+            self._transport = TransportController.for_van(
+                self.po_global.van, c, tier="global")
         # fp32 master-weight updates for fp16-stored keys (reference:
         # kSetMultiPrecision, kvstore_dist_server.h:324)
         self.multi_precision = False
@@ -1294,12 +1304,18 @@ class KVStoreDistServer:
 
     def _wan_wire_tag(self, st: _KeyState, n: int) -> str:
         """Wire codec for one forwarded slice of ``n`` elements: an
-        explicit GEOMX_WIRE_CODEC_WAN policy wins, else the forward
-        inherits the codec the workers pushed this round with, else the
-        party's own GEOMX_WIRE_CODEC routes by size. "" = leave the
-        hop to the configured gradient compressor."""
+        explicit GEOMX_WIRE_CODEC_WAN policy wins (operator intent),
+        else the transport controller's live per-link plan (once it has
+        measured evidence), else the forward inherits the codec the
+        workers pushed this round with, else the party's own
+        GEOMX_WIRE_CODEC routes by size. "" = leave the hop to the
+        configured gradient compressor."""
         if self._wire_wan is not None:
             return self._wire_wan.resolve(n)
+        if self._transport is not None:
+            tag = self._transport.wan_tag(n)
+            if tag is not None:
+                return tag
         if st.push_compr:
             return st.push_compr
         if self._wire.enabled():
@@ -1394,6 +1410,10 @@ class KVStoreDistServer:
     # overheads across the send queue.)
 
     def _flush_forward_batch(self, entries) -> None:
+        if self._transport is not None:
+            # refresh the transport plan once per WAN round (idempotent
+            # per round) so _wan_wire_tag sees the freshest decisions
+            self._transport.plan(self._wan_trace[0])
         per_rank: Dict[Tuple[int, str], List[tuple]] = {}
         for key, off, cycle in entries:
             st = self._state(key, off)
@@ -1578,6 +1598,8 @@ class KVStoreDistServer:
         """Inter-TS: contribute each global slice to the overlay (merged
         party-to-party), watch for the disseminated model (reference: the
         TS_Push / AutoPull2 path)."""
+        if self._transport is not None:
+            self._transport.plan(self._wan_trace[0])
         st = self._state(key, off)
         with st.lock:
             if st.cycle != cycle:

@@ -539,6 +539,16 @@ class ClusterHealthBoard:
     def render_json(self) -> str:
         return json.dumps(self.render(), separators=(",", ":"))
 
+    def degraded_links(self) -> frozenset:
+        """Currently-latched degraded ``(src, dst)`` pairs — the
+        transport controller / TSEngine schedule-bias input (the
+        ``link_degraded`` detector as an actuator signal, not just an
+        alert). Cheap enough for the matchmaking path."""
+        with self._lock:
+            return frozenset(
+                pair for pair, lk in self._links.items()
+                if lk["bw_latched"] or lk["loss_latched"])
+
     def export(self, round_idx: int) -> str:
         """Atomic per-round board export (tmp + rename, same contract
         as telemetry.export_round); never raises."""

@@ -136,7 +136,8 @@ class Postoffice:
             from geomx_tpu.ps.tsengine import TSScheduler
 
             self.ts_scheduler = TSScheduler(
-                self.van, num_workers, greed_rate=cfg.max_greed_rate_ts)
+                self.van, num_workers, greed_rate=cfg.max_greed_rate_ts,
+                avoid_degraded=cfg.transport_controller)
             self.van.ts_handler = self.ts_scheduler.handle
 
     # -- lifecycle -------------------------------------------------------

@@ -48,6 +48,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from geomx_tpu import telemetry
 from geomx_tpu.ps import base, linkstate, locks
 from geomx_tpu.ps.kv_app import KVPairs
 from geomx_tpu.ps.message import Control, Message, Meta
@@ -71,10 +72,16 @@ class TSScheduler:
     Attached to the scheduler node's van; one instance per tier overlay.
     """
 
-    def __init__(self, van, num_workers: int, greed_rate: float = 0.9):
+    def __init__(self, van, num_workers: int, greed_rate: float = 0.9,
+                 avoid_degraded: bool = False):
         self.van = van
         self.num_workers = num_workers
         self.greed = min(max(greed_rate, 0.0), 1.0)
+        # self-tuning transport (GEOMX_TRANSPORT_CONTROLLER): when the
+        # colocated health board has a link latched degraded, route the
+        # overlay around it — the link_degraded detector as an input,
+        # not just an alert. Off = the PR-12 matchmaking untouched.
+        self.avoid_degraded = avoid_degraded
         self._lock = locks.make_lock("TSScheduler._lock")
         # measured throughput matrix A: (src_id, dst_id) -> MB/s EWMA
         self.A: Dict[Tuple[int, int], float] = {}
@@ -113,6 +120,8 @@ class TSScheduler:
         key, off, ver = int(d["key"]), int(d.get("off", 0)), int(d["ver"])
         nm, tgt = int(d.get("nm", 1)), int(d.get("tgt", self.num_workers))
         replies: List[Tuple[int, int]] = []  # (to, dest)
+        bad = self._degraded()  # board lock stays outside ours
+        rerouted: List[Tuple[int, int]] = []
         with self._lock:
             self._prune(self._push_rounds, key, off, ver)
             if nm >= tgt:
@@ -122,23 +131,54 @@ class TSScheduler:
                 pend = self._push_rounds.setdefault((key, off, ver), set())
                 pend.add(sender)
                 while len(pend) >= 2:
-                    s, r = self._pick_pair(pend)
+                    s, r = self._pick_pair(pend, bad, rerouted)
                     pend.discard(s)
                     pend.discard(r)
                     replies.append((s, r))
+        for s, r in rerouted:
+            self._note_reroute("push", s, r)
         for to, dest in replies:
             self._reply(to, "push", key, off, ver, dest)
 
-    def _pick_pair(self, pend: set) -> Tuple[int, int]:
+    def _degraded(self) -> frozenset:
+        """Latched-degraded (src, dst) pairs from the colocated health
+        board; empty when the bias is off or no board runs here. Called
+        BEFORE taking our lock (the board has its own)."""
+        board = getattr(self.van, "healthboard", None)
+        if not self.avoid_degraded or board is None:
+            return frozenset()
+        return board.degraded_links()
+
+    def _note_reroute(self, kind: str, s: int, r: int) -> None:
+        telemetry.event("transport.reroute", cat="transport", kind=kind,
+                        src=s, dst=r)
+        rec = getattr(self.van, "flightrec", None)
+        if rec is not None:
+            rec.record("transport_reroute", kind=kind, src=s, dst=r)
+
+    def _pick_pair(self, pend: set, bad: frozenset = frozenset(),
+                   rerouted: Optional[list] = None) -> Tuple[int, int]:
         """Choose (sender, receiver) among pending askers: greedy by the
         throughput matrix with probability ``greed``, uniformly random
         otherwise so unmeasured links keep getting explored (reference:
-        MAX_GREED_RATE_TS, van.cc:436-443)."""
+        MAX_GREED_RATE_TS, van.cc:436-443). Pairs whose link is latched
+        degraded on the health board are avoided while any clean pair
+        remains (every-pair-degraded falls back to the plain pick — a
+        stalled overlay is worse than a slow hop)."""
         ids = list(pend)
+        pairs = [(s, r) for s in ids for r in ids if s != r]
+        filtered = False
+        if bad:
+            good = [p for p in pairs if p not in bad]
+            if good and len(good) < len(pairs):
+                pairs, filtered = good, True
         if self._rng.random() >= self.greed:
             s, r = self._rng.sample(ids, 2)
+            if filtered and (s, r) not in pairs:
+                s, r = self._rng.choice(pairs)
+                if rerouted is not None:
+                    rerouted.append((s, r))
             return s, r
-        pairs = [(s, r) for s in ids for r in ids if s != r]
         # shuffling makes the argmax tie-break random, so links with no
         # measurement yet (A=0) are sampled instead of dict-order-pinned
         self._rng.shuffle(pairs)
@@ -147,12 +187,16 @@ class TSScheduler:
             t = self.A.get((s, r), 0.0)
             if t > best_t:
                 best, best_t = (s, r), t
+        if filtered and rerouted is not None:
+            rerouted.append(best)
         return best
 
     # -- pull matchmaking (reference: ProcessAskPullCommand) -------------
 
     def _ask_pull(self, sender: int, d: dict) -> None:
         key, off, ver = int(d["key"]), int(d.get("off", 0)), int(d["ver"])
+        bad = self._degraded()
+        reroute = None
         with self._lock:
             self._prune(self._pull_rounds, key, off, ver)
             served = self._pull_rounds.setdefault((key, off, ver), set())
@@ -170,11 +214,19 @@ class TSScheduler:
                 # dissemination in a livelock
                 dest = DONE_DEST
             else:
+                pool = cands
+                if bad:
+                    clean = [c for c in cands if (sender, c) not in bad]
+                    if clean and len(clean) < len(cands):
+                        pool = clean
+                        reroute = sender
                 if self._rng.random() < self.greed:
-                    dest = max(cands, key=lambda c: self.A.get((sender, c), 0.0))
+                    dest = max(pool, key=lambda c: self.A.get((sender, c), 0.0))
                 else:
-                    dest = self._rng.choice(cands)
+                    dest = self._rng.choice(pool)
                 served.add(dest)
+        if reroute is not None:
+            self._note_reroute("pull", reroute, dest)
         self._reply(sender, "pull", key, off, ver, dest)
 
     # -- plumbing --------------------------------------------------------

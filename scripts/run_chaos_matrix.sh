@@ -184,6 +184,24 @@ else
   FAILED=1
 fi
 
+# adaptive transport on the same shaped 16-party topology: the
+# self-tuning controller (docs/adaptive-transport.md) drives per-link
+# codec + slice decisions from live health estimates while both
+# sanitizers audit every van and one shaped uplink is squeezed to
+# 5 Mbps mid-run. chaos_sim exits non-zero on any sanitizer marker, an
+# aborted round (incomplete worker), or a controller that made no live
+# decision.
+echo "=== chaos[shaped-16p-adaptive] seed=$SEED ==="
+if PS_SEED=$SEED JAX_PLATFORMS=cpu \
+     ${PYTHON:-python} "$(pwd)/../tools/chaos_sim.py" \
+     --parties 16 --seed "$SEED" --controller \
+     --shape "$(pwd)/shapes/hetero16.json"; then
+  echo "=== chaos[shaped-16p-adaptive] OK ==="
+else
+  echo "=== chaos[shaped-16p-adaptive] FAILED (re-run with PS_SEED=$SEED to reproduce) ==="
+  FAILED=1
+fi
+
 # quantized mesh + quantized van under a remote-server kill
 # (dist_sync_mesh): 2 parties x 2-virtual-device meshes, intra-party
 # gradients ride the int8 block-scaled ppermute ring

@@ -36,11 +36,21 @@ the identical shaped topology must then raise ZERO ``HEALTH-ANOMALY``
 markers — the detectors key on injected faults, not on shaping or
 scheduling noise.
 
+``--controller`` turns the same topology into the adaptive-transport
+chaos case (docs/adaptive-transport.md): the self-tuning transport
+controller is ON (per-link codec + slice decisions from live health
+estimates), both sanitizers audit every van, and a mid-run link
+squeeze drops party 9's shaped uplink to 5 Mbps while rounds are in
+flight. The bar: every worker completes every round (no round abort),
+ZERO sanitizer markers, and the controller exported per-link transport
+plans with at least one live codec decision.
+
 Same seed => the identical drop/delay/flap schedule AND the identical
 shaped delivery schedule (both planes draw from seeded streams).
 
     python tools/chaos_sim.py --parties 16 --seed 7
     python tools/chaos_sim.py --parties 16 --seed 7 --health
+    python tools/chaos_sim.py --parties 16 --seed 7 --controller
 """
 
 from __future__ import annotations
@@ -134,9 +144,16 @@ def main():
                          "raise straggler + link-degradation events "
                          "for the planned culprits; a clean run on the "
                          "same shaped topology must raise none")
+    ap.add_argument("--controller", action="store_true",
+                    help="adaptive-transport chaos: transport "
+                         "controller on, both sanitizers on, a mid-run "
+                         "squeeze of one shaped uplink; fails on any "
+                         "sanitizer marker or aborted round")
     args = ap.parse_args()
+    if args.health and args.controller:
+        ap.error("--health and --controller are separate cases")
     size = args.size if args.size is not None \
-        else (16384 if args.health else 65536)
+        else (16384 if (args.health or args.controller) else 65536)
 
     from geomx_tpu.optimizer import SGD
     from geomx_tpu.ps import base, linkstate, locks, sanitizer
@@ -150,18 +167,37 @@ def main():
     thin_ids = [gids[p] for p in thin]
     flapper = gids[n // 2]
 
-    rounds = max(args.rounds, 8) if args.health else args.rounds
+    rounds = max(args.rounds, 8) if (args.health or args.controller) \
+        else args.rounds
     extra = dict(
         ps_seed=args.seed,
-        fault_plan=(_health_fault_plan(thin_ids, flapper, args.seed)
-                    if args.health
-                    else _fault_plan(thin_ids, flapper, args.seed)),
         wire_sanitizer=True,
         lock_sanitizer=True,
         # drops/flaps heal through the resender; the deadline outlives
         # the longest flap window by a wide margin
         resend=True, resend_timeout_ms=500, resend_deadline_s=120.0,
     )
+    if not args.controller:
+        # the controller case injects no faults: the mid-run squeeze IS
+        # the chaos, and it rides the shaping plane, not the fault plane
+        extra["fault_plan"] = (
+            _health_fault_plan(thin_ids, flapper, args.seed)
+            if args.health else _fault_plan(thin_ids, flapper, args.seed))
+    plan_dir = ""
+    if args.controller:
+        import tempfile
+        plan_dir = tempfile.mkdtemp(prefix="geomx_ctrl_chaos_")
+        extra.update(
+            transport_controller=True,
+            health=True, health_dir=plan_dir,
+            heartbeat_interval_s=0.2, heartbeat_timeout_s=60,
+            # the shared 25 Mbps incast pipe queues ~1 s at 16 parties
+            # (same bar as --health): the retransmit timeout must clear
+            # it or healthy queueing reads as loss
+            resend_timeout_ms=3000,
+            health_degrade_factor=0.0, health_rtx_burst=3,
+            health_stall_s=300.0,
+        )
     if args.health:
         extra.update(
             health=True,
@@ -193,7 +229,11 @@ def main():
         plan = args.shape.strip()
         extra["shape_plan"] = plan if plan.startswith(("{", "[", "@")) \
             else "@" + plan
-    per_party = {p: {"wire_codec_wan": "2bit"} for p in thin}
+    # static per-party thin-leg codecs — except in controller mode,
+    # where a static override would win over the controller's decision
+    # (explicit config beats the plan) and defeat the case
+    per_party = {} if args.controller \
+        else {p: {"wire_codec_wan": "2bit"} for p in thin}
 
     trap = _MarkerTrap(sanitizer.MARKER)
     logging.getLogger("geomx.sanitizer").addHandler(trap)
@@ -202,7 +242,7 @@ def main():
     htrap = _MarkerTrap(linkstate.MARKER, level=logging.WARNING)
     logging.getLogger("geomx.health").addHandler(htrap)
 
-    def one_run(extra_cfg, label):
+    def one_run(extra_cfg, label, squeeze_after=0.0):
         print(f"# shaped chaos[{label}]: {n} parties, "
               f"{size * 4 // 1024} KB gradient, {rounds} rounds, "
               f"seed={args.seed}, shape={args.shape or 'off'}, "
@@ -211,6 +251,27 @@ def main():
         topo = InProcessHiPS(num_parties=n, workers_per_party=1,
                              extra_cfg=extra_cfg,
                              per_party_cfg=per_party).start()
+        squeezer = None
+        if squeeze_after > 0:
+            # mid-run link squeeze: party 9's shaped uplink to the
+            # global server collapses to 5 Mbps while rounds are in
+            # flight — prepended so it wins the first-match lookup
+            import threading
+            from geomx_tpu.ps.shaping import ShapeLink
+            gsrv = next(s for s in topo.servers if s.is_global_server)
+            shaper = gsrv.po_global.van._shaper
+
+            def _squeeze():
+                if shaper is None:
+                    return
+                shaper.plan.links.insert(0, ShapeLink(
+                    src=9, dst=8, tier="global",
+                    rtt_ms=150.0, bw_mbps=5.0))
+                print(f"# squeeze: link 9>8 now 5 Mbps / 150 ms "
+                      f"(t+{time.perf_counter() - t0:.1f}s)")
+
+            squeezer = threading.Timer(squeeze_after, _squeeze)
+            squeezer.start()
         finals = []
         try:
             def master_init(kv):
@@ -222,9 +283,10 @@ def main():
                 out = np.zeros(size, np.float32)
                 kv.init(0, np.zeros(size, np.float32))
                 for r in range(rounds):
-                    if args.health:
+                    if args.health or args.controller:
                         # combined rounds stamp Meta.trace_round — the
-                        # clock the board's straggler detector runs on
+                        # clock the board and the transport controller
+                        # both run on
                         kv.push_pull(0, np.full(size, float(r + 1),
                                                 np.float32), out)
                     else:
@@ -237,10 +299,15 @@ def main():
             topo.run_workers(worker, include_master=master_init,
                              timeout=args.timeout)
         finally:
+            if squeezer is not None:
+                squeezer.cancel()
             topo.stop()
         return finals, time.perf_counter() - t0
 
-    finals, wall = one_run(extra, "faulted" if args.health else "chaos")
+    label = ("faulted" if args.health
+             else "adaptive" if args.controller else "chaos")
+    finals, wall = one_run(
+        extra, label, squeeze_after=5.0 if args.controller else 0.0)
 
     ok = True
     if len(finals) != n:
@@ -260,6 +327,29 @@ def main():
         for h in ltrap.hits[:10]:
             print("  " + h)
         ok = False
+
+    if args.controller:
+        # the controller must have made live decisions: per-node plan
+        # exports with at least one codec assignment on a WAN link
+        plans = [f for f in os.listdir(plan_dir)
+                 if f.startswith("plan_")] if plan_dir else []
+        decided = 0
+        for f in plans:
+            try:
+                with open(os.path.join(plan_dir, f)) as fh:
+                    doc = json.load(fh)
+                decided += sum(1 for lk in doc.get("links", {}).values()
+                               if lk.get("codec"))
+            except (OSError, ValueError):
+                continue
+        print(f"# controller: {len(plans)} plan export(s), "
+              f"{decided} live codec decision(s)")
+        if not plans:
+            print("FAILED: controller exported no transport plans")
+            ok = False
+        elif decided == 0:
+            print("FAILED: controller made no live codec decision")
+            ok = False
 
     if args.health:
         planned = set(thin_ids) | {flapper}
@@ -302,7 +392,9 @@ def main():
 
     if ok:
         bar = ("health events fire on faults only, sanitizer clean"
-               if args.health else "sanitizer clean")
+               if args.health
+               else "controller live through the squeeze, sanitizer clean"
+               if args.controller else "sanitizer clean")
         print(f"OK: {n} shaped chaotic parties completed "
               f"{rounds} rounds in {wall:.1f}s, {bar}")
     sys.exit(0 if ok else 1)

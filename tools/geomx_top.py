@@ -28,6 +28,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 _BOARD_RE = re.compile(r"^board_(?P<node>.+)_round(?P<round>\d+)\.json$")
+_PLAN_RE = re.compile(r"^plan_(?P<tier>[^_]+)_(?P<node>.+)\.json$")
 
 
 def find_boards(health_dir: str) -> Dict[str, Tuple[int, str]]:
@@ -61,6 +62,46 @@ def load_boards(health_dir: str) -> List[dict]:
     return boards
 
 
+def load_plans(health_dir: str) -> Dict[Tuple[str, int], dict]:
+    """Active transport plans: the controller (kvstore/controller.py)
+    exports ``plan_<tier>_<node>.json`` atomically alongside the board
+    files. Keyed {(tier, src_node_id): plan dict} — local and global
+    van ids overlap, so the tier disambiguates."""
+    plans: Dict[Tuple[str, int], dict] = {}
+    try:
+        names = os.listdir(health_dir)
+    except OSError:
+        return plans
+    for name in names:
+        if _PLAN_RE.match(name) is None:
+            continue
+        try:
+            with open(os.path.join(health_dir, name), "r") as f:
+                doc = json.load(f)
+            plans[(str(doc["tier"]), int(doc["node"]))] = doc
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+    return plans
+
+
+def _plan_cell(plans: Dict[int, dict], link_name: str) -> str:
+    """Controller decision for one board link row ("src>dst"): the
+    assigned codec + last decision reason from src's exported plan."""
+    src, _, dst = link_name.partition(">")
+    try:
+        plan = plans.get(int(src))
+    except ValueError:
+        return ""
+    if plan is None:
+        return ""
+    lk = (plan.get("links") or {}).get(dst)
+    if lk is None:
+        return ""
+    codec = lk.get("codec") or "static"
+    cell = f"{codec}[{lk.get('reason', '')}]"
+    return cell
+
+
 def _bar(value: float, full: float, width: int = 10) -> str:
     if full <= 0:
         return " " * width
@@ -68,8 +109,16 @@ def _bar(value: float, full: float, width: int = 10) -> str:
     return "#" * n + "." * (width - n)
 
 
-def render_board(board: dict, now: Optional[float] = None) -> str:
-    """One board as a text block (pure function: tested directly)."""
+def render_board(board: dict, now: Optional[float] = None,
+                 plans: Optional[Dict[Tuple[str, int], dict]] = None
+                 ) -> str:
+    """One board as a text block (pure function: tested directly).
+    ``plans`` (from :func:`load_plans`) adds the active TransportPlan —
+    per-link codec + decision reason next to the link rows, plus each
+    sender's live slice budget. Only plans from this board's tier
+    apply (van ids overlap across tiers)."""
+    tier = str(board.get("tier", ""))
+    plans = {n: p for (t, n), p in (plans or {}).items() if t == tier}
     out: List[str] = []
     counts = board.get("event_counts", {})
     badge = ("  !! " + " ".join(f"{k}={v}" for k, v in sorted(counts.items()))
@@ -91,7 +140,7 @@ def render_board(board: dict, now: Optional[float] = None) -> str:
         peak = max((lk.get("bw_mbps", 0.0) for lk in links.values()),
                    default=0.0)
         out.append("  link        rtt_ms   bw_mbps  "
-                   + "bw".ljust(10) + "  rtx  gu  flags")
+                   + "bw".ljust(10) + "  rtx  gu  plan            flags")
         for name in sorted(links):
             lk = links[name]
             flags = "DEGRADED" if lk.get("degraded") else ""
@@ -100,7 +149,16 @@ def render_board(board: dict, now: Optional[float] = None) -> str:
                 f"  {lk.get('bw_mbps', 0.0):>8.1f}"
                 f"  {_bar(lk.get('bw_mbps', 0.0), peak)}"
                 f"  {lk.get('rtx', 0):>3}  {lk.get('give_ups', 0):>2}"
-                f"  {flags}")
+                f"  {_plan_cell(plans, name):<14}  {flags}")
+    if plans:
+        slices = [(n, p.get("slice_bytes", 0), p.get("round", -1))
+                  for n, p in sorted(plans.items())
+                  if p.get("slice_bytes")]
+        if slices:
+            out.append("  transport plan slice budgets:")
+            for n, sb, rnd in slices:
+                out.append(f"    node {n}: {sb // 1024} KB/chunk "
+                           f"(round {rnd})")
     events = board.get("events", [])
     if events:
         out.append("  recent events:")
@@ -118,7 +176,9 @@ def render_screen(boards: List[dict], health_dir: str) -> str:
     if not boards:
         return (head + "\n  (no board_*.json yet — is GEOMX_HEALTH=1 "
                 "and GEOMX_HEALTH_DIR set on the scheduler?)")
-    return "\n\n".join([head] + [render_board(b) for b in boards])
+    plans = load_plans(health_dir)
+    return "\n\n".join([head] + [render_board(b, plans=plans)
+                                 for b in boards])
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -142,7 +202,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.once:
             boards = load_boards(args.health_dir)
             if args.json:
-                print(json.dumps(boards, indent=2))
+                plans = {f"{t}:{n}": p for (t, n), p
+                         in load_plans(args.health_dir).items()}
+                print(json.dumps({"boards": boards, "plans": plans},
+                                 indent=2))
             else:
                 print(render_screen(boards, args.health_dir))
             return 0 if boards else 1

@@ -17,6 +17,13 @@ PERF.md "overlay vs incast" capture:
 The run asserts the two wires agree BIT-EXACTLY: gradients are
 integer-valued, so float32 summation is exact in any merge order and
 ``np.array_equal`` must hold between the direct and overlay results.
+
+``--controller`` adds a third pass — the overlay with the self-tuning
+transport controller on (per-link codec + slice decisions from live
+health estimates, degraded-link schedule bias; health plane + resender
+ride along) — for the static-vs-adaptive capture in PERF.md. The
+controller may assign lossy codecs, so THAT pass is checked for
+finiteness, not bit-exactness.
 """
 
 from __future__ import annotations
@@ -33,12 +40,16 @@ import numpy as np  # noqa: E402
 
 
 def run(parties: int, size: int, rounds: int, extra_cfg: dict,
-        inter_ts: bool):
+        inter_ts: bool, controller: bool = False):
     """One pass; returns (per-round ms, final weights)."""
     from geomx_tpu.optimizer import SGD
     from geomx_tpu.simulate import InProcessHiPS
 
     extra = dict(extra_cfg, enable_inter_ts=inter_ts)
+    if controller:
+        extra.update(transport_controller=True, health=True,
+                     resend=True, resend_timeout_ms=3000,
+                     resend_deadline_s=180.0)
     w0 = np.zeros(size, np.float32)
     topo = InProcessHiPS(num_parties=parties, workers_per_party=1,
                          extra_cfg=extra).start()
@@ -89,6 +100,11 @@ def main():
     ap.add_argument("--shape", default="scripts/shapes/hetero16.json",
                     help="ShapePlan JSON path or inline JSON; '' = off")
     ap.add_argument("--shape-seed", type=int, default=-1)
+    ap.add_argument("--controller", action="store_true",
+                    help="add an overlay pass with the self-tuning "
+                         "transport controller on (static-vs-adaptive "
+                         "A/B; that pass skips the bit-exact bar — the "
+                         "controller may assign lossy codecs)")
     args = ap.parse_args()
 
     extra = {}
@@ -115,6 +131,17 @@ def main():
     print(f"TS overlay    : {o:8.1f} ms/round   "
           f"(rounds: {', '.join(f'{t:.0f}' for t in overlay_ms)})")
     print(f"speedup       : {d / o:8.2f}x   (bit-exact: True)")
+
+    if args.controller:
+        ctrl_ms, ctrl_w = run(args.parties, args.size, args.rounds,
+                              extra, inter_ts=True, controller=True)
+        assert np.all(np.isfinite(ctrl_w)), \
+            "adaptive overlay produced non-finite weights"
+        c = np.median(ctrl_ms)
+        print(f"TS + controller: {c:7.1f} ms/round   "
+              f"(rounds: {', '.join(f'{t:.0f}' for t in ctrl_ms)})")
+        print(f"speedup vs direct: {d / c:5.2f}x   "
+              f"(lossy codecs allowed: finite-only bar)")
 
 
 if __name__ == "__main__":

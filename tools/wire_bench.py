@@ -22,6 +22,22 @@ bandwidth on every global-tier data frame. This is the PERF.md
 ``--trace-out`` dumps the in-process chrome trace (all nodes, one
 file) — feed it to ``python -m tools.trace_merge`` for the Perfetto
 artifact showing chunks in flight across rounds.
+
+``--loss-bench`` is the self-tuning-transport A/B (PERF.md
+"Self-tuning transport"): an N-party quadratic fit — every worker
+pushes ``grad = w - t`` and the server runs SGD, so
+``f(w) = 0.5 * ||w - t||^2`` contracts by a known factor per exact
+round — timed to a relative loss target on a shaped WAN, once per
+static codec policy (raw / fp16 / 2bit / mpq via
+``GEOMX_WIRE_CODEC_WAN``) and once with the transport controller
+choosing per-link (``--policy adaptive``):
+
+    python tools/wire_bench.py --loss-bench \
+        --shape scripts/shapes/hetero16.json --parties 16
+
+``--controller`` runs any of the OTHER modes with the controller on
+(health plane + resender come along) for a static-vs-adaptive capture
+of the protocol-only benches.
 """
 
 from __future__ import annotations
@@ -195,6 +211,90 @@ def run_overlap(shapes, rounds: int, slice_bytes: int,
     return serial, piped, nchunks[0]
 
 
+# the controller rides the health plane, which rides the resender
+# (spans come from send->ack); identical base config in every loss-bench
+# pass so the ONLY variable is the codec decision mechanism
+CONTROLLER_CFG = dict(
+    resend=True, resend_timeout_ms=3000, resend_deadline_s=180.0,
+    health=True,
+)
+
+LOSS_POLICIES = ("raw", "fp16", "2bit", "mpq", "adaptive")
+
+
+def run_loss(parties: int, size: int, policy: str, target_frac: float,
+             max_rounds: int, extra_cfg=None, prime: int = 2):
+    """Time-to-loss-target for one codec policy. Every worker pushes
+    ``grad = w - t`` (identical across workers: same target, same pulled
+    model), the server applies SGD at ``lr = 0.5 / parties``, so an
+    exact round halves the error and lossy codecs show up as extra
+    rounds. Workers break on the same round (the loss is computed from
+    the shared pulled model), so the FSA barrier never half-empties.
+
+    Returns ``(rounds_to_target | None, wall_s | None, loss_trace)``
+    where the wall time is the SLOWEST worker's."""
+    from geomx_tpu.optimizer import SGD
+    from geomx_tpu.simulate import InProcessHiPS
+
+    cfg = dict(extra_cfg or {})
+    cfg.update(CONTROLLER_CFG)
+    if policy == "adaptive":
+        cfg["transport_controller"] = True
+    elif policy != "raw":
+        cfg["wire_codec_wan"] = policy
+    topo = InProcessHiPS(num_parties=parties, workers_per_party=1,
+                         extra_cfg=cfg).start()
+    res = {}
+    try:
+        rng = np.random.RandomState(11)
+        t_vec = rng.standard_normal(size).astype(np.float32)
+        lr = 0.5 / parties
+
+        def master_init(kv):
+            kv.set_optimizer(SGD(learning_rate=lr))
+            kv.init(0, np.zeros(size, np.float32))
+            kv.wait()
+
+        def worker(kv):
+            out = np.zeros(size, np.float32)
+            kv.init(0, np.zeros(size, np.float32))
+            kv.pull(0, out=out)
+            kv.wait()
+            # untimed warmup, identical for every policy: zero gradients
+            # leave the model untouched (SGD no-op; 2bit codes zeros
+            # exactly, residuals stay zero) but put full-size frames on
+            # the wire — steady-state comparison, connection setup and
+            # the controller's link-classification both happen here
+            zero = np.zeros(size, np.float32)
+            for _ in range(prime):
+                fut = kv.push_pull_async(0, zero, out)
+                fut.wait()
+            loss0 = 0.5 * float(np.sum((out - t_vec) ** 2))
+            target = loss0 * target_frac
+            trace = []
+            hit = None
+            t0 = time.perf_counter()
+            for r in range(max_rounds):
+                fut = kv.push_pull_async(0, out - t_vec, out)
+                fut.wait()
+                loss = 0.5 * float(np.sum((out - t_vec) ** 2))
+                trace.append(loss / loss0)
+                if loss <= target:
+                    hit = (r + 1, time.perf_counter() - t0)
+                    break
+            res[id(kv)] = (hit, trace)
+
+        topo.run_workers(worker, include_master=master_init,
+                         timeout=1800)
+    finally:
+        topo.stop()
+    hits = [h for h, _ in res.values()]
+    trace = max((t for _, t in res.values()), key=len, default=[])
+    if any(h is None for h in hits) or not hits:
+        return None, None, trace
+    return max(h[0] for h in hits), max(h[1] for h in hits), trace
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--layout", choices=sorted(LAYOUTS), default="cnn")
@@ -220,6 +320,30 @@ def main():
     ap.add_argument("--trace-out", default="",
                     help="--overlap: dump the in-process chrome trace "
                          "here (merge with tools/trace_merge.py)")
+    ap.add_argument("--controller", action="store_true",
+                    help="run with the self-tuning transport controller "
+                         "on (health plane + resender ride along) for a "
+                         "static-vs-adaptive A/B of any mode")
+    ap.add_argument("--loss-bench", action="store_true",
+                    help="time-to-loss-target A/B across codec policies "
+                         "(raw/fp16/2bit/mpq/adaptive) on the shaped WAN")
+    ap.add_argument("--parties", type=int, default=16,
+                    help="--loss-bench: party count (default 16)")
+    ap.add_argument("--size", type=int, default=65536,
+                    help="--loss-bench: model elements (default 256KB)")
+    ap.add_argument("--target", type=float, default=3e-2,
+                    help="--loss-bench: relative loss target")
+    ap.add_argument("--max-rounds", type=int, default=40,
+                    help="--loss-bench: round cap; a policy that never "
+                         "reaches the target reports null")
+    ap.add_argument("--policy", default="",
+                    choices=("",) + LOSS_POLICIES,
+                    help="--loss-bench: run one policy only")
+    ap.add_argument("--prime", type=int, default=2,
+                    help="--loss-bench: untimed zero-gradient warmup "
+                         "rounds before the clock starts, same for "
+                         "every policy (steady-state comparison; 0 = "
+                         "include cold start)")
     args = ap.parse_args()
 
     extra_cfg = {}
@@ -228,6 +352,36 @@ def main():
         extra_cfg = {"shape_plan": "@" + args.shape,
                      "shape_seed": args.shape_seed}
         shape_tag = os.path.splitext(os.path.basename(args.shape))[0]
+    if args.controller:
+        extra_cfg.update(CONTROLLER_CFG, transport_controller=True)
+
+    if args.loss_bench:
+        if args.controller:
+            ap.error("--loss-bench runs its own adaptive policy; "
+                     "drop --controller")
+        # mpq's size rule must engage at this model size, or "mpq"
+        # degenerates to fp16 and the A/B loses a policy
+        extra_cfg.setdefault("size_lower_bound",
+                             min(200000, max(1, args.size // 2)))
+        rows = {}
+        for pol in ([args.policy] if args.policy else LOSS_POLICIES):
+            rounds, wall, trace = run_loss(
+                args.parties, args.size, pol, args.target,
+                args.max_rounds, extra_cfg=extra_cfg, prime=args.prime)
+            rows[pol] = {
+                "rounds_to_target": rounds,
+                "time_to_target_s": None if wall is None
+                else round(wall, 2),
+                "final_rel_loss": round(trace[-1], 6) if trace else None,
+            }
+            print(json.dumps({"policy": pol, **rows[pol]}),
+                  flush=True)
+        print(json.dumps({
+            "loss_bench": True, "shape": shape_tag,
+            "parties": args.parties, "size": args.size,
+            "target_rel": args.target, "max_rounds": args.max_rounds,
+            "prime": args.prime, "policies": rows}))
+        return
 
     shapes = LAYOUTS[args.layout]
     if shapes is None:
