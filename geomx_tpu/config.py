@@ -207,6 +207,19 @@ class Config:
     # the GX-L005..L007 lockmodel pass. Off-path cost is one branch at
     # lock construction. Test/chaos-matrix aid
     lock_sanitizer: bool = False        # GEOMX_LOCK_SANITIZER
+    # runtime state-model conformance sanitizer (ps/conformance.py):
+    # mirrors membership/epoch/recovery transitions through the
+    # executable protocol model (tools/analyze/statemodel.py) and flags
+    # any divergence between the live van and the model — the dynamic
+    # dual of the GX-S50x statemodel pass and the third leg of the
+    # one-model-two-enforcers planes. Test/chaos-matrix aid
+    state_sanitizer: bool = False       # GEOMX_STATE_SANITIZER
+    # deterministic registration rank for this process's local-tier van
+    # (Node.sort_key). Rendezvous ties otherwise break on ephemeral
+    # bind-port order, so WHICH worker gets local id 9 is a coin flip —
+    # launch scripts that target a specific worker by id (chaos matrix
+    # worker-kill) pin it per process. -1 keeps the port-order default
+    sort_key: int = -1                  # PS_SORT_KEY
     # ---- telemetry / flight recorder (ours; docs/observability.md) ----
     # metrics registry (geomx_tpu/telemetry.py): labeled counters/gauges/
     # histograms fed by the van, resender, servers and round futures;
@@ -418,6 +431,8 @@ def load() -> Config:
         chunk_retries=env_int("PS_CHUNK_RETRIES", 0),
         wire_sanitizer=env_bool("GEOMX_WIRE_SANITIZER"),
         lock_sanitizer=env_bool("GEOMX_LOCK_SANITIZER"),
+        state_sanitizer=env_bool("GEOMX_STATE_SANITIZER"),
+        sort_key=env_int("PS_SORT_KEY", -1),
         telemetry=env_bool("GEOMX_TELEMETRY"),
         telemetry_dir=env_str("GEOMX_TELEMETRY_DIR"),
         flightrec_size=env_int("GEOMX_FLIGHTREC_SIZE", 256),

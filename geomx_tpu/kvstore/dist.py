@@ -1938,18 +1938,26 @@ class KVStoreDist(KVStore):
         if getattr(self, "_closed", False):
             return
         self._closed = True
-        try:
-            self.wait(timeout=30.0)
-        except TimeoutError:
-            pass
-        # the master worker must NOT stop its local server (= the global
-        # server); party rank-0 workers do (reference: kvstore_dist.h:76-82)
-        if self.rank == 0 and not self.is_master_worker:
+        # a crashed (stopped) van can neither flush pending ops nor
+        # reach the scheduler: skip the goodbye protocol entirely
+        # instead of serially bleeding through the op, command and
+        # barrier timeouts — a chaos-crashed worker's atexit must exit
+        # promptly, not minutes later
+        dead = self.po.van.stopped.is_set()
+        if not dead:
             try:
-                self._send_command(Command.STOP_SERVER, "")
-            except (TimeoutError, OSError):
+                self.wait(timeout=30.0)
+            except TimeoutError:
                 pass
-        self.po.finalize(do_barrier=True)
+            # the master worker must NOT stop its local server (= the
+            # global server); party rank-0 workers do (reference:
+            # kvstore_dist.h:76-82)
+            if self.rank == 0 and not self.is_master_worker:
+                try:
+                    self._send_command(Command.STOP_SERVER, "")
+                except (TimeoutError, OSError):
+                    pass
+        self.po.finalize(do_barrier=not dead)
 
     def __del__(self):
         pass  # explicit close() required; avoid surprises at gc time

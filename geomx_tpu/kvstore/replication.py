@@ -307,7 +307,7 @@ class ReplicationManager:
 
     # -- recovery side ---------------------------------------------------
 
-    def _fetch_from_peer(self) -> Optional[bytes]:
+    def _fetch_from_peer(self, timeout: float = 60.0) -> Optional[bytes]:
         peer = self._peer_rank()
         if peer is None:
             return None
@@ -316,7 +316,7 @@ class ReplicationManager:
             ts = kvw.request(Command.REPLICA_FETCH,
                              json.dumps({"rank": self._po().my_rank}),
                              psbase.server_rank_to_id(peer))
-            kvw.wait(ts, 60.0)
+            kvw.wait(ts, timeout)
             for resp in kvw.take_response_bodies(ts):
                 if resp:
                     return bytes.fromhex(resp)
@@ -326,7 +326,18 @@ class ReplicationManager:
         return None
 
     def restore(self) -> Optional[str]:
-        """Repopulate the server from its snapshot (or a peer's replica).
+        """Repopulate the server from its snapshot or a peer's replica —
+        whichever is FRESHER (higher summed shard version).
+
+        A snapshot is written on the periodic tick; the peer's replica
+        advances every replicated round. After a crash the on-disk
+        snapshot can therefore lag the replica by up to a tick interval
+        — restoring it blindly (the old behavior) silently rolled those
+        rounds back. Both candidates are deserialized and the higher
+        version total wins; the snapshot wins ties (it is local and
+        already includes the updater blob). The peer fetch uses a short
+        timeout when a snapshot exists (best-effort upgrade) and the
+        long one when the snapshot is the only hope.
 
         Called by ``KVStoreDistServer.start`` when either tier's van came
         up with ``is_recovery=True``, BEFORE ``_ready`` is set — no
@@ -334,24 +345,46 @@ class ReplicationManager:
         used ("snapshot"/"replica") or None (nothing to restore: the old
         volatile-store behavior, documented in tests/test_recovery.py)."""
         t0 = time.monotonic()
-        blob: Optional[bytes] = None
-        source = None
+        check = getattr(self._po().van, "statecheck", None)
+        if check is not None:
+            check.on_restore("starting", self.server._ready.is_set())
+        candidates = []  # (source, doc, entries), snapshot first
         if self.enabled and os.path.exists(self.path()):
             try:
                 with open(self.path(), "rb") as f:
-                    blob = f.read()
-                source = "snapshot"
-            except OSError as e:
+                    raw = f.read()
+                doc = checkpoint.deserialize_blob(raw)
+                candidates.append(
+                    ("snapshot", doc,
+                     checkpoint.deserialize_states(doc["entries"])))
+            except (OSError, ValueError, KeyError) as e:
                 log.warning("snapshot read failed (%s); trying peer", e)
-        if blob is None:
-            blob = self._fetch_from_peer()
-            source = "replica" if blob is not None else None
-        if blob is None:
+        peer_blob = self._fetch_from_peer(
+            timeout=5.0 if candidates else 60.0)
+        if peer_blob is not None:
+            try:
+                doc = checkpoint.deserialize_blob(peer_blob)
+                candidates.append(
+                    ("replica", doc,
+                     checkpoint.deserialize_states(doc["entries"])))
+            except (ValueError, KeyError) as e:
+                log.warning("peer replica unusable (%s)", e)
+        if not candidates:
             log.info("recovery: no snapshot and no replica — store starts "
                      "empty (workers must re-init)")
             return None
-        doc = checkpoint.deserialize_blob(blob)
-        entries = checkpoint.deserialize_states(doc["entries"])
+
+        def freshness(cand):
+            return sum(int(e.get("version", 0))
+                       for e in cand[2].values())
+
+        # max() keeps the FIRST maximal element: the snapshot on ties
+        source, doc, entries = max(candidates, key=freshness)
+        if len(candidates) == 2:
+            log.info("recovery: snapshot version total %d vs replica %d "
+                     "— restoring from %s",
+                     freshness(candidates[0]), freshness(candidates[1]),
+                     source)
         self._apply(doc, entries, source)
         dur_ms = (time.monotonic() - t0) * 1e3
         log.info("recovery: restored %d shard states from %s in %.1f ms",

@@ -774,6 +774,12 @@ class KVStoreDistServer:
         # round complete (reference: :1324)
         st.rounds += 1
         reqs, st.push_reqs = st.push_reqs, []
+        check = getattr(self.po_local.van, "statecheck", None)
+        if check is not None:
+            # conformance: every aggregated contribution must have
+            # passed the is_stale fence (duplicates from num_merge
+            # collapse into one (sender, epoch) pair)
+            check.on_release(key, {(r.sender, r.epoch) for r, _srv in reqs})
 
         if not self.has_global_tier:
             # single-tier PS: apply the update here

@@ -81,6 +81,9 @@ class Postoffice:
             verbose=cfg.verbose,
             # GEOMX_WIRE_SANITIZER: per-van protocol-invariant checking
             wire_sanitizer=cfg.wire_sanitizer,
+            # GEOMX_STATE_SANITIZER: per-van membership/epoch model
+            # conformance checking (ps/conformance.py)
+            state_sanitizer=cfg.state_sanitizer,
             # GEOMX_FLIGHTREC_SIZE/_DIR: crash flight recorder ring
             flightrec_size=cfg.flightrec_size,
             flightrec_dir=cfg.flightrec_dir,
@@ -109,6 +112,13 @@ class Postoffice:
                 "grace_s": cfg.dgt_grace_ms / 1000.0,
             } if (is_global and cfg.enable_dgt) else None,
         )
+        # PS_SORT_KEY: deterministic local-tier registration rank (the
+        # scheduler sorts registrations by Node.sort_key before falling
+        # back to ephemeral bind-port order, which is a per-run coin
+        # flip). Global vans keep the server-rank alignment assigned in
+        # kvstore/server.py instead
+        if cfg.sort_key >= 0 and not is_global:
+            self.van.sort_key = cfg.sort_key
         # GEOMX_TELEMETRY/_DIR: the registry is process-wide; only push
         # affirmative settings so several in-process nodes (simulate.
         # InProcessHiPS) can't have the last default Config turn it off

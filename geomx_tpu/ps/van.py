@@ -84,6 +84,7 @@ class Van:
         fault_plan: Optional["faults_mod.FaultPlan"] = None,
         shape_plan: Optional["shaping_mod.ShapePlan"] = None,
         wire_sanitizer: bool = False,
+        state_sanitizer: bool = False,
         flightrec_size: int = 256,
         flightrec_dir: str = "",
         health: bool = False,
@@ -146,6 +147,14 @@ class Van:
         # van dies, a round aborts or the sanitizer flags a violation
         self.flightrec = FlightRecorder(self.node_tag, size=flightrec_size,
                                         out_dir=flightrec_dir)
+        # runtime state-model conformance sanitizer
+        # (GEOMX_STATE_SANITIZER): mirrors membership/epoch/recovery
+        # transitions through the executable model the GX-S50x lint pass
+        # freezes and tools/modelcheck.py explores; report() at stop()
+        self.statecheck = None
+        if state_sanitizer:
+            from geomx_tpu.ps.conformance import StateSanitizer
+            self.statecheck = StateSanitizer(self)
         # geomx-healthd (GEOMX_HEALTH): every van continuously estimates
         # per-link RTT/goodput/loss from send→ack spans; non-schedulers
         # piggyback a digest on their HEARTBEAT frames, the scheduler
@@ -318,6 +327,8 @@ class Van:
         log.debug("%s van.stop()", self._tag())
         if self.sanitizer is not None:
             self.sanitizer.on_shutdown()
+        if self.statecheck is not None:
+            self.statecheck.on_shutdown()
         self.stopped.set()
         if self._resender is not None:
             self._resender.stop()
@@ -987,12 +998,28 @@ class Van:
             # live, the PREVIOUS holder of the id stays fenced via
             # _rejoin_epoch)
             with self._member_lock:
+                changed = False
                 if msg.meta.epoch > self.membership_epoch:
                     self.membership_epoch = msg.meta.epoch
+                    changed = True
                 for n in msg.meta.nodes:
                     if n.is_recovery and n.id in self._declared_dead:
                         self._declared_dead.discard(n.id)
                         self._rejoin_epoch[n.id] = self.membership_epoch
+                        changed = True
+                epoch_now = self.membership_epoch
+                dead_now = frozenset(self._declared_dead)
+                if self.statecheck is not None:
+                    self.statecheck.on_table(
+                        msg.meta.epoch,
+                        [n.id for n in msg.meta.nodes if n.is_recovery],
+                        (epoch_now, dead_now))
+            if changed:
+                # a revival learned through the table broadcast re-fires
+                # the side effects exactly like a DEAD_NODE adoption —
+                # without this a server that missed the rejoin DEAD_NODE
+                # never re-checks its countdowns against the new view
+                self._membership_side_effects(epoch_now, dead_now)
             if self.my_id != -1:
                 self.ready.set()
 
@@ -1022,6 +1049,9 @@ class Van:
                                 self.membership_epoch += 1
                                 self._rejoin_epoch[old.id] = \
                                     self.membership_epoch
+                                if self.statecheck is not None:
+                                    self.statecheck.on_revive(
+                                        old.id, self.membership_epoch)
                         break
                 else:
                     log.warning("re-registration with no matching dead slot")
@@ -1106,6 +1136,13 @@ class Van:
     # ------------------------------------------------------------------
 
     def barrier(self, group: int, timeout: float = 300.0) -> None:
+        # a stopped (crashed or shut-down) van can neither deliver the
+        # request nor receive the release — fail fast instead of
+        # parking the caller for the full timeout (a crashed chaos
+        # worker's exit path must not bleed out through serial barrier
+        # timeouts)
+        if self.stopped.is_set():
+            raise OSError("van stopped; barrier unavailable")
         ev = threading.Event()
         with self._barrier_lock:
             self._barrier_done[group] = ev
@@ -1119,8 +1156,12 @@ class Van:
             )
         )
         self.send(msg)
-        if not ev.wait(timeout):
-            raise TimeoutError(f"barrier on group {group} timed out")
+        end = time.monotonic() + timeout
+        while not ev.wait(min(1.0, max(0.0, end - time.monotonic()))):
+            if self.stopped.is_set():
+                raise OSError("van stopped during barrier")
+            if time.monotonic() >= end:
+                raise TimeoutError(f"barrier on group {group} timed out")
 
     def _process_barrier(self, msg: Message) -> None:
         if msg.meta.request:
@@ -1257,6 +1298,8 @@ class Van:
             self.membership_epoch += 1
             epoch = self.membership_epoch
             dead = frozenset(self._declared_dead)
+            if self.statecheck is not None:
+                self.statecheck.on_declare(fresh, epoch, dead)
         log.warning("%s membership epoch %d: declaring %s dead (dead set "
                     "now %s)", self._tag(), epoch, sorted(fresh),
                     sorted(dead))
@@ -1299,17 +1342,26 @@ class Van:
         new_dead = {n.id for n in msg.meta.nodes}
         with self._member_lock:
             if epoch < self.membership_epoch:
-                return  # stale broadcast (reordered/retransmitted)
-            if (epoch == self.membership_epoch
+                # stale broadcast (reordered/retransmitted)
+                outcome = "stale"
+            elif (epoch == self.membership_epoch
                     and new_dead == self._declared_dead):
-                return  # duplicate: side effects already fired
-            # ids leaving the dead set were revived (slot re-filled):
-            # fence the previous holder's in-flight traffic
-            for nid in self._declared_dead - new_dead:
-                self._rejoin_epoch[nid] = epoch
-            self._declared_dead = set(new_dead)
-            self.membership_epoch = epoch
-            dead = frozenset(new_dead)
+                outcome = "duplicate"  # side effects already fired
+            else:
+                outcome = "adopt"
+                # ids leaving the dead set were revived (slot
+                # re-filled): fence the previous holder's traffic
+                for nid in self._declared_dead - new_dead:
+                    self._rejoin_epoch[nid] = epoch
+                self._declared_dead = set(new_dead)
+                self.membership_epoch = epoch
+            dead = frozenset(self._declared_dead)
+            if self.statecheck is not None:
+                self.statecheck.on_dead_node(
+                    epoch, new_dead, outcome,
+                    (self.membership_epoch, dead))
+        if outcome != "adopt":
+            return
         log.info("%s membership epoch %d: dead set %s", self._tag(),
                  epoch, sorted(dead))
         self._membership_side_effects(epoch, dead)
@@ -1355,8 +1407,11 @@ class Van:
         sender is declared dead, or its epoch predates the sender id's
         rejoin (the previous holder of a re-filled slot)."""
         with self._member_lock:
-            return (sender in self._declared_dead
-                    or epoch < self._rejoin_epoch.get(sender, 0))
+            stale = (sender in self._declared_dead
+                     or epoch < self._rejoin_epoch.get(sender, 0))
+            if self.statecheck is not None:
+                self.statecheck.on_fence(sender, epoch, stale)
+            return stale
 
     def notify_round(self, round_idx: int) -> None:
         """Training-round clock for deterministic fault injection

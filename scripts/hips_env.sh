@@ -81,15 +81,21 @@ launch_hips() {
       DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=2 \
       $PYTHON -c "import geomx_tpu" > /tmp/hips_sched_$PPORT.log 2>&1 &
     launch_hips_party_server "$PPORT" "$PHOST" "$NH_P" 2
+    # PS_SORT_KEY pins each worker's local rank (worker $w -> local id
+    # 9/11 deterministically) — registration otherwise sorts by
+    # ephemeral bind port, a per-run coin flip, and the chaos matrix
+    # worker-kill case targets local id 9 by plan
     for w in 0 1; do
       if [ "$PPORT" = "$BPORT" ] && [ "$w" = "1" ]; then
         # last worker runs in the foreground (reference pattern)
         env $NH_P DMLC_ROLE=worker DMLC_PS_ROOT_URI=$PHOST DMLC_PS_ROOT_PORT=$PPORT \
           DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=2 DMLC_NUM_ALL_WORKER=4 \
+          PS_SORT_KEY=$w \
           $PYTHON -u $script --data-slice-idx $slice $extra
       else
         env $NH_P DMLC_ROLE=worker DMLC_PS_ROOT_URI=$PHOST DMLC_PS_ROOT_PORT=$PPORT \
           DMLC_NUM_SERVER=1 DMLC_NUM_WORKER=2 DMLC_NUM_ALL_WORKER=4 \
+          PS_SORT_KEY=$w \
           $PYTHON $script --data-slice-idx $slice $extra > /tmp/hips_w$slice.log 2>&1 &
       fi
       slice=$((slice+1))

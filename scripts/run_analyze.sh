@@ -1,8 +1,10 @@
 #!/bin/bash
-# geomx-lint from any cwd, all five analysis families: lock/lock-model
+# geomx-lint from any cwd, all six analysis families: lock/lock-model
 # (GX-L, concurrency + lockmodel passes), traced-code (GX-J),
-# config-drift (GX-C), wire-protocol (GX-P3xx) and metrics-funnel
-# (GX-M4xx) analysis.
+# config-drift (GX-C), wire-protocol (GX-P3xx), membership state-model
+# (GX-S5xx, frozen to state.lock.json; explorer in tools/modelcheck.py,
+# runtime dual GEOMX_STATE_SANITIZER=1) and metrics-funnel (GX-M4xx)
+# analysis.
 # Flags pass through, e.g.:  scripts/run_analyze.sh --passes traced --json
 # See docs/static-analysis.md for the rule catalogue + baseline workflow.
 set -euo pipefail

@@ -264,10 +264,28 @@ fi
 # each party's server re-sizes the round countdown to the survivors,
 # and the remaining worker per party completes the full run.
 export PS_HEARTBEAT_INTERVAL=1 PS_HEARTBEAT_TIMEOUT=3
+# the crashed workers' own kv.wait should give up with the resend
+# deadline, not the default 300s op timeout — their exit path is serial
+# in this single-host run
+export PS_OP_TIMEOUT=120
+# the full sanitizer complement rides along — wire (ack exactly once),
+# lock (order witness) and state (every declare/adopt/fence must agree
+# with the executable membership model in tools/analyze/statemodel.py).
+# Membership churn is exactly what the state sanitizer mirrors, so a
+# kill case with a silent sanitizer is the strongest conformance run.
+export GEOMX_WIRE_SANITIZER=1 GEOMX_LOCK_SANITIZER=1 GEOMX_STATE_SANITIZER=1
 run_case worker-kill \
   '[{"type": "crash", "node": 9, "at_round": 3, "tier": "local"}]' \
   9890 "$@"
-unset PS_HEARTBEAT_INTERVAL PS_HEARTBEAT_TIMEOUT
+unset PS_HEARTBEAT_INTERVAL PS_HEARTBEAT_TIMEOUT PS_OP_TIMEOUT
+unset GEOMX_WIRE_SANITIZER GEOMX_LOCK_SANITIZER GEOMX_STATE_SANITIZER
+for marker in WIRE LOCK STATE; do
+  if grep -l "$marker-SANITIZER VIOLATION" /tmp/hips_*.log 2>/dev/null; then
+    echo "=== chaos[worker-kill] FAILED: $marker sanitizer violations (see logs above) ==="
+    collect_artifacts worker-kill-sanitizer "$LAST_FDIR" "$LAST_TDIR"
+    FAILED=1
+  fi
+done
 
 # elastic membership + durable recovery: party A's server crashes on
 # its 50th local data frame (mid-round). Its workers' in-flight rounds
@@ -285,6 +303,10 @@ CASE_DIRS+=("$LAST_FDIR" "$LAST_TDIR")
   export GEOMX_FLIGHTREC_DIR=$LAST_FDIR
   export GEOMX_TELEMETRY=1 GEOMX_TELEMETRY_DIR=$LAST_TDIR
   export PS_SNAPSHOT_DIR=$(mktemp -d) PS_SNAPSHOT_INTERVAL=1
+  # all three sanitizers ride the crash + recovery: the state sanitizer
+  # mirrors the dead-declaration, the replacement's revival and the
+  # survivors' fences through the executable membership model
+  export GEOMX_WIRE_SANITIZER=1 GEOMX_LOCK_SANITIZER=1 GEOMX_STATE_SANITIZER=1
   # scoped via hips_env.sh so ONLY party A's server runs this plan — a
   # node/tier match alone also hits party B's server and the global
   # servers' local role (all are local id 8)
@@ -309,6 +331,13 @@ else
   collect_artifacts server-kill "$LAST_FDIR" "$LAST_TDIR"
   FAILED=1
 fi
+for marker in WIRE LOCK STATE; do
+  if grep -l "$marker-SANITIZER VIOLATION" /tmp/hips_*.log 2>/dev/null; then
+    echo "=== chaos[server-kill] FAILED: $marker sanitizer violations (see logs above) ==="
+    collect_artifacts server-kill-sanitizer "$LAST_FDIR" "$LAST_TDIR"
+    FAILED=1
+  fi
+done
 
 # a green matrix leaves nothing behind; a red one leaves $ARTIFACTS
 [ $FAILED -eq 0 ] && rm -rf "${CASE_DIRS[@]}"

@@ -1,0 +1,32 @@
+"""Seeded GX-S50x violations on the server side of the state model."""
+
+
+class KVStoreDistServer:
+    # GX-S504: the is_stale fence is gone — a declared-dead zombie's
+    # push aggregates into the round
+    def _handle_data(self, req):
+        return self._push_local_store(req)
+
+    def _handle_command(self, req):
+        if self.po_local.van.is_stale(req.sender, req.epoch):
+            return None
+        return self._run_command(req)
+
+    # GX-S504: countdown sized from the static worker count, not the
+    # live membership view — a mid-round death wedges the round forever
+    def _expected_local_pushes(self):
+        return max(self.num_workers, 1)
+
+    def _expected_global_elems(self):
+        return max(self.po_global.num_live_workers(), 1)
+
+    # GX-S503: the membership hook no longer re-checks the local
+    # countdown — rounds already past the old threshold never release
+    def _on_membership(self, epoch, dead):
+        self._expected_global_elems()
+        self._complete_fsa_round()
+
+    def start(self):
+        if self.po_local.van.is_recovery:
+            self.replication.restore()
+        self._ready.set()

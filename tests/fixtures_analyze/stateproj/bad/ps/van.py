@@ -1,0 +1,65 @@
+"""Seeded GX-S50x violations: membership anchors that drifted from the
+executable model (tools/analyze/statemodel.py). Each broken element is
+marked; the clean counterpart lives in ../../clean/ps/van.py."""
+
+
+class Van:
+    def __init__(self):
+        self._declared_dead = set()
+        self._rejoin_epoch = {}
+        self.membership_epoch = 0
+        self.is_recovery = False
+
+    # GX-S503: declare_dead stopped broadcasting (required call
+    # `_broadcast_membership` is gone) — survivors never learn the epoch
+    def declare_dead(self, ids):
+        self._declared_dead.update(ids)
+        self.membership_epoch += 1
+        self._membership_side_effects(self.membership_epoch,
+                                      frozenset(self._declared_dead))
+
+    def _scheduler_register(self, node):
+        if node.id in self._declared_dead:
+            self._declared_dead.discard(node.id)
+            self.membership_epoch += 1
+            self._rejoin_epoch[node.id] = self.membership_epoch
+            self._broadcast_membership(self.membership_epoch,
+                                       frozenset(self._declared_dead))
+
+    # GX-S504: the epoch guard is gone — stale DEAD_NODE broadcasts
+    # (reordered/retransmitted) roll the dead set back
+    def _process_dead_node(self, msg):
+        new_dead = {n.id for n in msg.nodes}
+        for nid in self._declared_dead - new_dead:
+            self._rejoin_epoch[nid] = msg.epoch
+        self._declared_dead = set(new_dead)
+        self.membership_epoch = msg.epoch
+        self._membership_side_effects(msg.epoch, frozenset(new_dead))
+
+    def _process_add_node(self, msg):
+        if msg.epoch > self.membership_epoch:
+            self.membership_epoch = msg.epoch
+        for n in msg.nodes:
+            if n.is_recovery and n.id in self._declared_dead:
+                self._declared_dead.discard(n.id)
+                self._rejoin_epoch[n.id] = self.membership_epoch
+        self.is_recovery = False
+        self._membership_side_effects(self.membership_epoch,
+                                      frozenset(self._declared_dead))
+
+    # GX-S503: the rejoin-fence read is gone — a zombie whose slot was
+    # re-filled passes the fence as long as it is not in the dead set
+    def is_stale(self, sender, epoch):
+        return sender in self._declared_dead
+
+    # GX-S502: mutates modeled membership state outside any modeled
+    # transition — invisible to the model and the conformance sanitizer
+    def reset_membership(self):
+        self._declared_dead.clear()
+        self.membership_epoch = 0
+
+    def _broadcast_membership(self, epoch, dead):
+        pass
+
+    def _membership_side_effects(self, epoch, dead):
+        pass

@@ -1,0 +1,12 @@
+"""Clean restore_merge anchor: restore() consults both sources."""
+
+
+class ReplicationManager:
+    def restore(self):
+        blob = self._read_snapshot()
+        peer = self._fetch_from_peer(timeout=5.0)
+        doc, entries, source = self._pick(blob, peer)
+        if doc is None:
+            return None
+        self._apply(doc, entries, source)
+        return source

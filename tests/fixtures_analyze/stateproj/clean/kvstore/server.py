@@ -1,0 +1,30 @@
+"""Clean counterpart of ../../bad/kvstore/server.py."""
+
+
+class KVStoreDistServer:
+    def _handle_data(self, req):
+        if self.po_local.van.is_stale(req.sender, req.epoch):
+            return None
+        return self._push_local_store(req)
+
+    def _handle_command(self, req):
+        if self.po_local.van.is_stale(req.sender, req.epoch):
+            return None
+        return self._run_command(req)
+
+    def _expected_local_pushes(self):
+        return max(self.po_local.num_live_workers(), 1)
+
+    def _expected_global_elems(self):
+        return max(self.po_global.num_live_workers(), 1)
+
+    def _on_membership(self, epoch, dead):
+        self._expected_local_pushes()
+        self._expected_global_elems()
+        self._complete_local_round(None, None)
+        self._complete_fsa_round()
+
+    def start(self):
+        if self.po_local.van.is_recovery:
+            self.replication.restore()
+        self._ready.set()

@@ -1,0 +1,58 @@
+"""Clean counterpart of ../../bad/ps/van.py: every modeled transition
+realized with its required writes, calls, reads and fences — must stay
+silent under GX-S502/S503/S504."""
+
+
+class Van:
+    def __init__(self):
+        self._declared_dead = set()
+        self._rejoin_epoch = {}
+        self.membership_epoch = 0
+        self.is_recovery = False
+
+    def declare_dead(self, ids):
+        self._declared_dead.update(ids)
+        self.membership_epoch += 1
+        epoch = self.membership_epoch
+        dead = frozenset(self._declared_dead)
+        self._broadcast_membership(epoch, dead)
+        self._membership_side_effects(epoch, dead)
+
+    def _scheduler_register(self, node):
+        if node.id in self._declared_dead:
+            self._declared_dead.discard(node.id)
+            self.membership_epoch += 1
+            self._rejoin_epoch[node.id] = self.membership_epoch
+            self._broadcast_membership(self.membership_epoch,
+                                       frozenset(self._declared_dead))
+
+    def _process_dead_node(self, msg):
+        new_dead = {n.id for n in msg.nodes}
+        if msg.epoch < self.membership_epoch:
+            return
+        for nid in self._declared_dead - new_dead:
+            self._rejoin_epoch[nid] = msg.epoch
+        self._declared_dead = set(new_dead)
+        self.membership_epoch = msg.epoch
+        self._membership_side_effects(msg.epoch, frozenset(new_dead))
+
+    def _process_add_node(self, msg):
+        if msg.epoch > self.membership_epoch:
+            self.membership_epoch = msg.epoch
+        for n in msg.nodes:
+            if n.is_recovery and n.id in self._declared_dead:
+                self._declared_dead.discard(n.id)
+                self._rejoin_epoch[n.id] = self.membership_epoch
+        self.is_recovery = False
+        self._membership_side_effects(self.membership_epoch,
+                                      frozenset(self._declared_dead))
+
+    def is_stale(self, sender, epoch):
+        return (sender in self._declared_dead
+                or epoch < self._rejoin_epoch.get(sender, 0))
+
+    def _broadcast_membership(self, epoch, dead):
+        pass
+
+    def _membership_side_effects(self, epoch, dead):
+        pass

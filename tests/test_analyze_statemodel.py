@@ -1,0 +1,204 @@
+"""Pytest gate for the statemodel pass (tools/analyze/statemodel.py,
+GX-S501..S504) and its executable model.
+
+Jobs:
+
+1. Prove every rule fires against the seeded fixtures in
+   tests/fixtures_analyze/stateproj/bad/ (and that the clean
+   counterparts stay clean).
+2. Lock workflow round-trip: missing lock -> freeze -> clean -> drift.
+3. Gate the real tree — the committed state.lock.json must match the
+   transition signatures extracted from the live sources, and a
+   deliberate epoch-handling edit to the real van.py must fail GX-S503.
+4. Model-unit checks: the MemberView/SchedulerView transitions the
+   explorer and the runtime conformance sanitizer both rely on.
+"""
+
+import shutil
+from pathlib import Path
+
+import pytest
+
+from tools.analyze import load_sources
+from tools.analyze.statemodel import (MemberView, SchedulerView,
+                                      extract_state_model,
+                                      run_statemodel,
+                                      state_model_fingerprint,
+                                      statemodel_lock_path,
+                                      write_state_model)
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = Path(__file__).resolve().parent / "fixtures_analyze" / "stateproj"
+
+
+def _findings(tree: Path, root=None):
+    sources = load_sources([tree], tree)
+    return run_statemodel(sources, root if root is not None else tree)
+
+
+def _details(findings, rule):
+    return {f.detail for f in findings if f.rule == rule}
+
+
+# ---------------------------------------------------------------------------
+# seeded violations fire
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def bad(tmp_path_factory):
+    # freeze a lock for the bad tree so S501 noise doesn't mix into the
+    # S502/S503/S504 assertions
+    root = tmp_path_factory.mktemp("badroot")
+    shutil.copytree(FIXTURES / "bad", root / "src")
+    sources = load_sources([root / "src"], root / "src")
+    write_state_model(sources, root)
+    return run_statemodel(sources, root)
+
+
+def test_lost_broadcast_fires_s503(bad):
+    assert "declare_dead:missing-call:_broadcast_membership" \
+        in _details(bad, "GX-S503")
+
+
+def test_lost_rejoin_fence_read_fires_s503(bad):
+    assert "stale_fence:missing-read:_rejoin_epoch" \
+        in _details(bad, "GX-S503")
+
+
+def test_membership_hook_losing_recheck_fires_s503(bad):
+    d = _details(bad, "GX-S503")
+    assert "membership_release:missing-call:_expected_local_pushes" in d
+    assert "membership_release:missing-call:_complete_local_round" in d
+
+
+def test_lost_epoch_guard_fires_s504(bad):
+    assert "adopt_broadcast:epoch-guard" in _details(bad, "GX-S504")
+
+
+def test_lost_stale_push_fence_fires_s504(bad):
+    assert "stale_push_drop:is_stale" in _details(bad, "GX-S504")
+
+
+def test_static_countdown_fires_s504(bad):
+    assert "local_countdown:live-view" in _details(bad, "GX-S504")
+
+
+def test_out_of_transition_mutation_fires_s502(bad):
+    hits = [f for f in bad if f.rule == "GX-S502"]
+    assert {h.symbol for h in hits} == {"Van.reset_membership"}
+    assert {h.detail for h in hits} == {"_declared_dead",
+                                        "membership_epoch"}
+
+
+def test_clean_fixtures_stay_clean(tmp_path):
+    shutil.copytree(FIXTURES / "clean", tmp_path / "src")
+    sources = load_sources([tmp_path / "src"], tmp_path / "src")
+    write_state_model(sources, tmp_path)
+    assert run_statemodel(sources, tmp_path) == []
+
+
+# ---------------------------------------------------------------------------
+# lock workflow round-trip (GX-S501)
+# ---------------------------------------------------------------------------
+
+def test_lock_round_trip(tmp_path):
+    shutil.copytree(FIXTURES / "clean", tmp_path / "src")
+    sources = load_sources([tmp_path / "src"], tmp_path / "src")
+
+    # 1. no lock: S501 lock-missing
+    out = run_statemodel(sources, tmp_path)
+    assert _details(out, "GX-S501") == {"lock-missing"}
+
+    # 2. freeze: clean
+    lock = write_state_model(sources, tmp_path)
+    assert lock == statemodel_lock_path(tmp_path)
+    assert run_statemodel(sources, tmp_path) == []
+
+    # 3. drift: change a transition's protocol surface (drop the
+    #    broadcast from declare_dead) -> S501 model-changed
+    van = tmp_path / "src" / "ps" / "van.py"
+    text = van.read_text()
+    assert "self._broadcast_membership(epoch, dead)" in text
+    van.write_text(text.replace(
+        "self._broadcast_membership(epoch, dead)", "pass", 1))
+    sources = load_sources([tmp_path / "src"], tmp_path / "src")
+    out = run_statemodel(sources, tmp_path)
+    assert "model-changed" in _details(out, "GX-S501")
+
+
+# ---------------------------------------------------------------------------
+# real-tree gate
+# ---------------------------------------------------------------------------
+
+def test_committed_state_lock_matches_tree():
+    """The committed lock must equal what the live sources extract —
+    i.e. `python -m tools.analyze --update-state-model` was run after
+    the last membership-protocol change."""
+    import json
+
+    sources = load_sources([REPO / "geomx_tpu"], REPO)
+    model = extract_state_model(sources)
+    assert model, "no modeled transitions extracted from geomx_tpu/"
+    doc = json.loads(statemodel_lock_path(REPO).read_text())
+    frozen = doc["files"]
+    assert sorted(frozen) == sorted(model)
+    for rel, entry in model.items():
+        assert frozen[rel]["fingerprint"] == state_model_fingerprint(
+            entry), f"state.lock.json stale for {rel}"
+
+
+def test_real_tree_is_clean():
+    sources = load_sources([REPO / "geomx_tpu"], REPO)
+    assert run_statemodel(sources, REPO) == []
+
+
+def test_deliberate_epoch_edit_fails_gate(tmp_path):
+    """Strip the epoch bump from the REAL declare_dead: the gate must
+    fail with GX-S503 (the code no longer realizes the modeled
+    transition)."""
+    dst = tmp_path / "src" / "ps"
+    dst.mkdir(parents=True)
+    text = (REPO / "geomx_tpu" / "ps" / "van.py").read_text()
+    needle = "self.membership_epoch += 1\n            epoch = self.membership_epoch"
+    assert needle in text, "declare_dead epoch bump moved — update test"
+    (dst / "van.py").write_text(text.replace(
+        needle, "epoch = self.membership_epoch", 1))
+    sources = load_sources([tmp_path / "src"], tmp_path / "src")
+    out = run_statemodel(sources, tmp_path)
+    assert "declare_dead:missing-write:membership_epoch" \
+        in _details(out, "GX-S503")
+
+
+# ---------------------------------------------------------------------------
+# executable model units (shared by modelcheck + conformance)
+# ---------------------------------------------------------------------------
+
+def test_member_adopt_broadcast_outcomes():
+    v = MemberView()
+    assert v.adopt_broadcast(1, {11}) == "adopt"
+    assert (v.epoch, v.dead) == (1, {11})
+    assert v.adopt_broadcast(1, {11}) == "duplicate"
+    assert v.adopt_broadcast(0, set()) == "stale"
+    # revival via broadcast arms the rejoin fence at the new epoch
+    assert v.adopt_broadcast(2, set()) == "adopt"
+    assert v.rejoin == {11: 2}
+    assert v.is_stale(11, 1) and not v.is_stale(11, 2)
+
+
+def test_member_adopt_table_reports_change():
+    v = MemberView()
+    assert v.adopt_table(0, []) is False         # initial table: no-op
+    v.adopt_broadcast(1, {11})
+    assert v.adopt_table(2, [11]) is True        # revival via table
+    assert v.dead == set() and v.rejoin == {11: 2}
+    assert v.adopt_table(2, []) is False         # idempotent re-delivery
+
+
+def test_scheduler_declare_and_revive():
+    s = SchedulerView()
+    assert s.declare_dead([11, 12]) == (1, frozenset({11, 12}))
+    assert s.declare_dead([11]) is None          # already dead: no bump
+    assert s.revive(11) == 2
+    assert s.rejoin == {11: 2} and s.dead == {12}
+    assert s.is_stale(11, 1) and not s.is_stale(11, 2)
+    assert s.is_stale(12, 2)                     # still dead

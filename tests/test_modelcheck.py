@@ -1,0 +1,127 @@
+"""Gate for the small-scope explorer (tools/modelcheck.py).
+
+Three jobs:
+
+1. Clean protocol: every scenario explores to quiescence with zero
+   invariant violations (the big churn scenario is nightly-tier).
+2. Mutation teeth: each seeded fence/behavior removal trips EXACTLY its
+   documented invariant — proving the invariants actually distinguish
+   the real protocol from its broken neighbors.
+3. Replay: the offline conformance pass over flight-recorder dumps
+   flags seeded epoch regressions and stays silent on clean rings.
+"""
+
+import json
+
+import pytest
+
+from tools.modelcheck import (MUTANTS, SCENARIOS, explore, replay_events,
+                              replay_paths, run_clean, run_mutants)
+
+FAST = {n: s for n, s in SCENARIOS.items() if n != "churn-3w2s"}
+
+
+# ---------------------------------------------------------------------------
+# clean protocol
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(FAST))
+def test_scenario_clean(name):
+    res = explore(SCENARIOS[name], frozenset(), scenario=name)
+    assert res.violations == [], (
+        f"{name}: {[ (v.invariant, v.detail) for v in res.violations ]}")
+    assert res.terminals > 0
+
+
+@pytest.mark.slow
+def test_churn_scenario_clean():
+    """3 workers / 2 servers with crash + rejoin — the headline scope
+    (~270k states, ~1 min)."""
+    res = explore(SCENARIOS["churn-3w2s"], frozenset(),
+                  scenario="churn-3w2s")
+    assert res.violations == []
+    assert res.states > 100_000      # the scope actually is that big
+
+
+# ---------------------------------------------------------------------------
+# mutation teeth
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mutant", sorted(MUTANTS))
+def test_mutant_trips_exactly_its_invariant(mutant):
+    flag, scenario, expected = MUTANTS[mutant]
+    res = explore(SCENARIOS[scenario], frozenset([flag]),
+                  scenario=scenario)
+    assert res.invariants_hit == [expected], (
+        f"{mutant} ({flag} under {scenario}): expected exactly "
+        f"[{expected}], hit {res.invariants_hit}")
+
+
+def test_run_mutants_wrapper_agrees():
+    for name, (res, expected) in run_mutants().items():
+        assert res.invariants_hit == [expected], name
+
+
+# ---------------------------------------------------------------------------
+# partial-order reduction soundness (on the scenarios where full
+# exploration is cheap): same verdicts with and without POR
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", ["zombie-rejoin", "double-declare",
+                                  "recovery-2r"])
+def test_por_equivalence(name):
+    with_por = explore(SCENARIOS[name], frozenset(), por=True,
+                       scenario=name)
+    without = explore(SCENARIOS[name], frozenset(), por=False,
+                      scenario=name)
+    assert with_por.violations == [] and without.violations == []
+    # POR may only SHRINK the explored graph, never change verdicts
+    assert with_por.states <= without.states
+
+
+def test_run_clean_wrapper(capsys=None):
+    out = run_clean(only="crash-only")
+    assert list(out) == ["crash-only"]
+    assert out["crash-only"].violations == []
+
+
+# ---------------------------------------------------------------------------
+# replay
+# ---------------------------------------------------------------------------
+
+def _wire(seq, peer, epoch, kind="recv"):
+    return {"seq": seq, "kind": kind, "peer": peer, "epoch": epoch}
+
+
+def test_replay_flags_epoch_regression():
+    problems = replay_events([
+        _wire(1, 9, 1), _wire(2, 9, 2), _wire(3, 9, 1)])
+    assert len(problems) == 1 and "epoch 1 after seeing 2" in problems[0]
+
+
+def test_replay_flags_non_monotonic_declare():
+    problems = replay_events([
+        {"seq": 1, "kind": "membership", "event": "declare_dead",
+         "epoch": 2, "dead": [11]},
+        {"seq": 2, "kind": "membership", "event": "declare_dead",
+         "epoch": 2, "dead": [12]}])
+    assert len(problems) == 1 and "not above 2" in problems[0]
+
+
+def test_replay_clean_ring_is_silent():
+    assert replay_events([
+        _wire(1, 9, 1, "sent"), _wire(2, 9, 1), _wire(3, 9, 2),
+        {"seq": 4, "kind": "membership", "event": "declare_dead",
+         "epoch": 3, "dead": [11]},
+        _wire(5, 9, 3)]) == []
+
+
+def test_replay_paths_over_dump_files(tmp_path):
+    (tmp_path / "flightrec_a.json").write_text(json.dumps({
+        "node": "l8", "events": [_wire(1, 9, 2), _wire(2, 9, 1)]}))
+    (tmp_path / "flightrec_b.json").write_text(json.dumps({
+        "node": "l9", "events": [_wire(1, 8, 1)]}))
+    (tmp_path / "unrelated.json").write_text("{}")
+    report = replay_paths([tmp_path])
+    assert report["violations"] == 1
+    assert [f["node"] for f in report["files"]] == ["l8", "l9"]

@@ -24,6 +24,14 @@ accelerator):
   ``tools/analyze/locks.lock.json`` (drift fails GX-L007, the runtime
   witness in ``geomx_tpu/ps/locks.py`` loads the same json), unguarded
   multi-thread-root writes, ``Condition.wait`` outside a while loop.
+- **statemodel** (GX-S501..S504): the geomx-statecheck shared model —
+  the membership/epoch/recovery/round-release state machine as an
+  executable model plus per-transition code anchors frozen into
+  ``tools/analyze/state.lock.json`` (drift fails GX-S501; the small-
+  scope explorer ``tools/modelcheck.py`` and the runtime conformance
+  sanitizer ``geomx_tpu/ps/conformance.py`` run the SAME model),
+  out-of-transition state mutations, unrealized transitions, dropped
+  ``is_stale``/live-view/epoch fences.
 
 Run ``python -m tools.analyze`` from the repo root; see
 docs/static-analysis.md for the rule catalogue, baseline workflow and
@@ -43,15 +51,16 @@ from .config_drift import run_config_drift
 from .lockmodel import run_lockmodel, write_lock_model
 from .metrics import run_metrics
 from .protocol import run_protocol, write_binmeta_lock
+from .statemodel import run_statemodel, write_state_model
 from .traced import run_traced
 
 __all__ = [
     "Finding", "SEV_ERROR", "SEV_WARNING", "SourceFile",
     "run_concurrency", "run_traced", "run_config_drift", "run_protocol",
-    "run_metrics", "run_lockmodel", "run_all",
-    "write_binmeta_lock", "write_lock_model",
+    "run_metrics", "run_lockmodel", "run_statemodel", "run_all",
+    "write_binmeta_lock", "write_lock_model", "write_state_model",
     "load_baseline", "save_baseline", "split_by_baseline",
-    "sort_findings", "DEFAULT_BASELINE",
+    "sort_findings", "pass_fingerprints", "DEFAULT_BASELINE",
 ]
 
 DEFAULT_BASELINE = Path(__file__).with_name("baseline.json")
@@ -63,7 +72,38 @@ PASSES = {
     "protocol": run_protocol,
     "metrics": lambda sources, root: run_metrics(sources),
     "lockmodel": run_lockmodel,
+    "statemodel": run_statemodel,
 }
+
+
+def pass_fingerprints(sources, root) -> dict:
+    """One short fingerprint per pass model, so CI can diff a single
+    ``--json`` stream across runs: a changed fingerprint means the
+    extracted surface that pass reasons about (lock inventory, traced
+    entry set, env-knob registry, wire schema, metric funnel, protocol
+    state machine) changed — findings or not."""
+    from .concurrency import concurrency_surface
+    from .config_drift import config_drift_surface
+    from .lockmodel import extract_lock_model, model_fingerprint
+    from .metrics import metrics_surface
+    from .protocol import extract_meta_schema, meta_schema_fingerprint
+    from .statemodel import extract_state_model, state_model_fingerprint
+    from .traced import traced_surface
+
+    def _fp(surface) -> str:
+        return model_fingerprint(surface)
+
+    schema = extract_meta_schema(sources)
+    return {
+        "concurrency": _fp(concurrency_surface(sources)),
+        "traced": _fp(traced_surface(sources)),
+        "config-drift": _fp(config_drift_surface(sources, Path(root))),
+        "protocol": (meta_schema_fingerprint(schema[3])[:16]
+                     if schema is not None else ""),
+        "metrics": _fp(metrics_surface(sources)),
+        "lockmodel": _fp(extract_lock_model(sources)),
+        "statemodel": _fp(extract_state_model(sources)),
+    }
 
 
 def run_all(paths: Sequence[Path], root: Path,

@@ -7,7 +7,10 @@ to exactly the current findings (do this after fixing or accepting);
 lock`` refreshes the wire-schema lock after a BINMETA_VERSION bump;
 ``--update-lock-model`` refreshes the geomx-racecheck lock model
 (tools/analyze/locks.lock.json) after a deliberate lock/@guarded_by
-change."""
+change; ``--update-state-model`` refreshes the geomx-statecheck
+protocol state model (tools/analyze/state.lock.json) after a reviewed
+membership/epoch/recovery protocol change (re-explore with
+``python -m tools.modelcheck`` first)."""
 
 from __future__ import annotations
 
@@ -17,8 +20,9 @@ import sys
 from pathlib import Path
 
 from . import (DEFAULT_BASELINE, PASSES, load_baseline, load_sources,
-               run_all, save_baseline, split_by_baseline,
-               write_binmeta_lock, write_lock_model)
+               pass_fingerprints, run_all, save_baseline,
+               split_by_baseline, write_binmeta_lock, write_lock_model,
+               write_state_model)
 
 
 def main(argv=None) -> int:
@@ -51,6 +55,10 @@ def main(argv=None) -> int:
                     help="refresh tools/analyze/locks.lock.json from "
                          "the current lock inventory + @guarded_by "
                          "declarations")
+    ap.add_argument("--update-state-model", action="store_true",
+                    help="refresh tools/analyze/state.lock.json from "
+                         "the current membership/epoch protocol "
+                         "transition signatures")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable findings (rule, file, line, "
                          "fingerprint) for CI / chaos-matrix diffing")
@@ -72,6 +80,11 @@ def main(argv=None) -> int:
     if args.update_lock_model:
         lock = write_lock_model(load_sources(paths, root), root)
         print(f"lock model updated -> {lock}")
+        return 0
+
+    if args.update_state_model:
+        lock = write_state_model(load_sources(paths, root), root)
+        print(f"state model updated -> {lock}")
         return 0
 
     findings = run_all(paths, root, passes)
@@ -100,12 +113,17 @@ def main(argv=None) -> int:
 
     if args.json:
         # fingerprint included so CI / the chaos matrix can diff runs
-        # by identity instead of grepping rendered stderr lines
+        # by identity instead of grepping rendered stderr lines; the
+        # per-pass model fingerprints let one stream also flag surface
+        # drift (lock inventory, knob registry, protocol model, ...)
+        # that produced no finding
         print(json.dumps({
             "new": [{**vars(f), "fingerprint": f.fingerprint}
                     for f in new],
             "accepted": [{**vars(f), "fingerprint": f.fingerprint}
                          for f in accepted],
+            "fingerprints": pass_fingerprints(
+                load_sources(paths, root), root),
         }, indent=1))
     else:
         for f in new:

@@ -406,3 +406,43 @@ def run_concurrency(sources: Sequence[SourceFile]) -> List[Finding]:
                 _scan_method(scope, m.name, m)
             findings += _scope_findings(scope, src.rel)
     return findings
+
+
+def concurrency_surface(sources: Sequence[SourceFile]) -> dict:
+    """The surface this pass reasons about, for the unified ``--json``
+    fingerprint stream: per file, per scope, the lock inventory and the
+    lock-acquisition order edges. A changed fingerprint means the lock
+    graph moved even when no inversion (yet) fires."""
+    out: Dict[str, dict] = {}
+    for src in sources:
+        if src.tree is None:
+            continue
+        modname = Path(src.rel).stem
+        scopes: Dict[str, dict] = {}
+        mod_scope = _ScopeInfo(f"{modname}.<module>", "")
+        _collect_locks(mod_scope, [src.tree], prefix_self=False)
+        for node in src.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _scan_method(mod_scope, node.name, node)
+        candidates = [mod_scope]
+        for cls in [n for n in ast.walk(src.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            scope = _ScopeInfo(f"{modname}.{cls.name}", "self.")
+            methods = [n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+            _collect_locks(scope, methods, prefix_self=True)
+            for m in methods:
+                _scan_method(scope, m.name, m)
+            candidates.append(scope)
+        for scope in candidates:
+            if not scope.locks:
+                continue
+            scopes[scope.qualname] = {
+                "locks": {name: d.kind
+                          for name, d in sorted(scope.locks.items())},
+                "edges": sorted(f"{a}->{b}" for a, b in scope.edges),
+            }
+        if scopes:
+            out[src.rel] = scopes
+    return out

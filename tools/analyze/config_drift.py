@@ -186,3 +186,17 @@ def run_config_drift(sources: Sequence[SourceFile], root: Path,
                 message=(f"launch script sets {name!r} but no code "
                          f"reads it — dead knob or typo")))
     return findings
+
+
+def config_drift_surface(sources: Sequence[SourceFile], root: Path,
+                         config_rel: str = "geomx_tpu/config.py",
+                         doc_rel: str = "docs/env-var-summary.md") -> dict:
+    """The surface this pass reasons about, for the unified ``--json``
+    fingerprint stream: the registered env-knob names and the documented
+    rows. A changed fingerprint means the knob registry moved."""
+    config_src = next((s for s in sources if s.rel == config_rel), None)
+    regs = parse_registrations(config_src) if config_src else {}
+    return {
+        "registered": sorted(regs),
+        "documented": sorted(parse_doc_vars(Path(root) / doc_rel)),
+    }

@@ -28,7 +28,7 @@ on ``telemetry.gauge_set``/``telemetry.counter_inc``.
 from __future__ import annotations
 
 import ast
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Set
 
 from .core import Finding, SEV_WARNING, SourceFile, call_name, const_str
 
@@ -111,3 +111,27 @@ def run_metrics(sources: Sequence[SourceFile]) -> List[Finding]:
                              "names and src/dst/tier labels cannot "
                              "drift per call site")))
     return findings
+
+
+def metrics_surface(sources: Sequence[SourceFile]) -> dict:
+    """The surface this pass reasons about, for the unified ``--json``
+    fingerprint stream: every raw-profiler and link-metric call site
+    (file + enclosing symbol + metric name, line-free)."""
+    out: Dict[str, List[str]] = {}
+    for src in sources:
+        if src.tree is None:
+            continue
+        sites: Set[str] = set()
+        for fn, qual in _index_functions(src.tree):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node.func)
+                metric = (const_str(node.args[0])
+                          if node.args else None) or "<dynamic>"
+                if name in _RAW_CALLS or (name in _LINK_CALLS
+                                          and metric.startswith("link.")):
+                    sites.add(f"{qual}:{name}:{metric}")
+        if sites:
+            out[src.rel] = sorted(sites)
+    return out

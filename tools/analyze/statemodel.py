@@ -1,0 +1,529 @@
+"""State-model pass: the shared model behind geomx-statecheck.
+
+The membership/epoch/recovery/round-release protocol lives smeared
+across three files — ``ps/van.py`` (scheduler heartbeat-lapse →
+``declare_dead`` → epoch bump → DEAD_NODE broadcast; member mirroring;
+``_rejoin_epoch`` zombie fencing), ``kvstore/server.py`` (live-view
+countdown sizing, ``_on_membership`` round release, ``is_stale`` push
+fencing) and ``kvstore/replication.py`` (snapshot/replica restore on
+``is_recovery``). This module makes that state machine EXPLICIT twice
+over:
+
+1. An **executable model** (:class:`MemberView` / :class:`SchedulerView`)
+   — the pure-python transition functions that ``tools/modelcheck.py``
+   explores exhaustively at small scope and that the runtime conformance
+   sanitizer (``geomx_tpu/ps/conformance.py``, ``GEOMX_STATE_SANITIZER``)
+   runs in lock-step against the live van.
+
+2. A **transition table** (:data:`TRANSITIONS`) binding every modeled
+   transition to its anchor method in the real tree, with the state
+   fields it must write, the protocol verbs it must call and the fences
+   (``is_stale`` / live-view countdown / epoch guard) it must carry.
+   The extracted per-file signature is frozen into
+   ``tools/analyze/state.lock.json`` (same lock-file workflow as the
+   binary-meta schema and the racecheck lock model); drift fails
+   GX-S501 and ``python -m tools.analyze --update-state-model`` moves
+   the lock after a reviewed protocol change.
+
+State machine (scheduler on the left, every member mirrors on the right)::
+
+    heartbeat lapse > grace          DEAD_NODE(epoch, full dead set)
+    ──────────────────────▶ declare_dead ────────────────────────▶ adopt
+         epoch += 1                                   (stale/dup dropped)
+    re-registration          ADD_NODE table(epoch, is_recovery slots)
+    ──────────────────────▶ revive_rejoin ──────────────────────▶ adopt
+         epoch += 1, _rejoin_epoch[id] = epoch        (old holder fenced)
+
+    server: push ──▶ is_stale fence ──▶ countdown (live view) ──▶ release
+    server: epoch bump ──▶ _on_membership re-checks countdowns ──▶ release
+    server: start(is_recovery) ──▶ replication.restore (before serving)
+
+Rules
+-----
+GX-S501 (error) the extracted transition signatures of an analyzed file
+                drifted from ``tools/analyze/state.lock.json`` (lock
+                missing, unreadable, entry missing, or fingerprint
+                changed). After a deliberate protocol change:
+                ``--update-state-model`` and commit the lock diff.
+GX-S502 (error) a modeled state field (``membership_epoch``,
+                ``_declared_dead``, ``_rejoin_epoch``, ``is_recovery``)
+                is mutated outside a modeled transition — an epoch bump
+                or dead-set edit the model (and therefore modelcheck and
+                the runtime sanitizer) cannot see.
+GX-S503 (error) a modeled transition is unreachable in code: its anchor
+                method is gone, or a required state write / protocol
+                call / state read no longer appears in it.
+GX-S504 (error) a modeled transition lost its fence: the ``is_stale``
+                zombie fence, the live-view countdown sizing, or the
+                epoch monotonicity guard.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, SEV_ERROR, SourceFile, call_name
+
+# ---------------------------------------------------------------------------
+# the executable model — shared by modelcheck (exploration) and the
+# runtime conformance sanitizer (lock-step mirroring)
+# ---------------------------------------------------------------------------
+
+
+class MemberView:
+    """One node's view of cluster membership: the epoch, the dead set
+    and the per-id rejoin fence. The transition methods mirror
+    ``ps/van.py`` exactly — ``adopt_broadcast`` is ``_process_dead_node``,
+    ``adopt_table`` is the member branch of ``_process_add_node``,
+    ``is_stale`` is ``Van.is_stale``."""
+
+    __slots__ = ("epoch", "dead", "rejoin")
+
+    def __init__(self, epoch: int = 0, dead=(), rejoin=()):
+        self.epoch = epoch
+        self.dead: Set[int] = set(dead)
+        self.rejoin: Dict[int, int] = dict(rejoin)
+
+    # -- transitions -----------------------------------------------------
+
+    def adopt_broadcast(self, epoch: int, new_dead) -> str:
+        """DEAD_NODE arrival (full dead set + epoch). Returns the
+        outcome the real handler takes: "stale" (older epoch, dropped),
+        "duplicate" (same epoch + same set, side effects already fired)
+        or "adopt"."""
+        new_dead = set(new_dead)
+        if epoch < self.epoch:
+            return "stale"
+        if epoch == self.epoch and new_dead == self.dead:
+            return "duplicate"
+        # ids leaving the dead set were revived: fence the previous
+        # holder's in-flight traffic at the broadcast epoch
+        for nid in self.dead - new_dead:
+            self.rejoin[nid] = epoch
+        self.dead = new_dead
+        self.epoch = epoch
+        return "adopt"
+
+    def adopt_table(self, epoch: int, revived) -> bool:
+        """ADD_NODE table broadcast: adopt a newer epoch; recovery
+        entries revive their slot (the previous holder stays fenced).
+        Returns True when the view changed (callers re-run membership
+        side effects exactly then)."""
+        changed = False
+        if epoch > self.epoch:
+            self.epoch = epoch
+            changed = True
+        for nid in revived:
+            if nid in self.dead:
+                self.dead.discard(nid)
+                self.rejoin[nid] = self.epoch
+                changed = True
+        return changed
+
+    def is_stale(self, sender: int, epoch: int) -> bool:
+        """The zombie fence: a message is stale when its sender is in
+        the dead set, or its epoch predates the sender id's rejoin."""
+        return sender in self.dead or epoch < self.rejoin.get(sender, 0)
+
+    # -- plumbing --------------------------------------------------------
+
+    def live(self, ids) -> List[int]:
+        return sorted(i for i in ids if i not in self.dead)
+
+    def snapshot(self) -> tuple:
+        return (self.epoch, tuple(sorted(self.dead)),
+                tuple(sorted(self.rejoin.items())))
+
+    def copy(self) -> "MemberView":
+        return MemberView(self.epoch, self.dead, self.rejoin)
+
+
+class SchedulerView(MemberView):
+    """The scheduler's authoritative view: it ORIGINATES epochs.
+    ``declare_dead`` mirrors ``Van.declare_dead``; ``revive`` mirrors
+    the recovery branch of ``Van._scheduler_register``."""
+
+    def declare_dead(self, ids, known=None) -> Optional[Tuple[int, frozenset]]:
+        fresh = [i for i in ids if i not in self.dead
+                 and (known is None or i in known)]
+        if not fresh:
+            return None
+        self.dead.update(fresh)
+        self.epoch += 1
+        return self.epoch, frozenset(self.dead)
+
+    def revive(self, nid: int) -> int:
+        """Hand a dead slot to a rejoining node: prune the dead set,
+        bump the epoch, arm the rejoin fence for the OLD holder."""
+        if nid in self.dead:
+            self.dead.discard(nid)
+            self.epoch += 1
+            self.rejoin[nid] = self.epoch
+        return self.epoch
+
+    def copy(self) -> "SchedulerView":
+        return SchedulerView(self.epoch, self.dead, self.rejoin)
+
+
+# ---------------------------------------------------------------------------
+# the transition table: model <-> code anchors
+# ---------------------------------------------------------------------------
+
+#: state fields owned by the membership plane; any store outside a
+#: modeled transition is GX-S502
+MODELED_FIELDS = ("_declared_dead", "_rejoin_epoch", "is_recovery",
+                  "membership_epoch")
+
+#: the class that owns the modeled fields (file suffix, class name)
+FIELD_OWNER = ("ps/van.py", "Van")
+
+FENCE_EPOCH_GUARD = "epoch-guard"
+FENCE_IS_STALE = "is_stale"
+FENCE_LIVE_VIEW = "live-view"
+
+_LIVE_VIEW_CALLS = {"num_live_workers", "num_live_servers",
+                    "live_worker_ids", "live_server_ids", "live_ids"}
+_MUTATOR_CALLS = {"add", "discard", "remove", "update", "pop", "clear",
+                  "setdefault", "extend", "append"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Transition:
+    name: str
+    file: str            # rel-path suffix of the anchor file
+    cls: str
+    method: str
+    writes: tuple = ()   # modeled fields the anchor must store
+    calls: tuple = ()    # protocol verbs the anchor must call
+    reads: tuple = ()    # modeled fields the anchor must read
+    fences: tuple = ()   # FENCE_* the anchor must carry
+
+
+TRANSITIONS: Tuple[Transition, ...] = (
+    # -- scheduler side (ps/van.py) -------------------------------------
+    Transition("declare_dead", "ps/van.py", "Van", "declare_dead",
+               writes=("_declared_dead", "membership_epoch"),
+               calls=("_broadcast_membership", "_membership_side_effects")),
+    Transition("revive_rejoin", "ps/van.py", "Van", "_scheduler_register",
+               writes=("_declared_dead", "membership_epoch",
+                       "_rejoin_epoch"),
+               calls=("_broadcast_membership",)),
+    # -- member mirroring (ps/van.py) -----------------------------------
+    Transition("adopt_broadcast", "ps/van.py", "Van", "_process_dead_node",
+               writes=("_declared_dead", "membership_epoch",
+                       "_rejoin_epoch"),
+               calls=("_membership_side_effects",),
+               fences=(FENCE_EPOCH_GUARD,)),
+    Transition("adopt_table", "ps/van.py", "Van", "_process_add_node",
+               writes=("membership_epoch", "_declared_dead",
+                       "_rejoin_epoch", "is_recovery"),
+               calls=("_membership_side_effects",),
+               fences=(FENCE_EPOCH_GUARD,)),
+    Transition("stale_fence", "ps/van.py", "Van", "is_stale",
+               reads=("_declared_dead", "_rejoin_epoch")),
+    # -- server round machine (kvstore/server.py) -----------------------
+    Transition("stale_push_drop", "kvstore/server.py",
+               "KVStoreDistServer", "_handle_data",
+               fences=(FENCE_IS_STALE,)),
+    Transition("stale_command_drop", "kvstore/server.py",
+               "KVStoreDistServer", "_handle_command",
+               fences=(FENCE_IS_STALE,)),
+    Transition("local_countdown", "kvstore/server.py",
+               "KVStoreDistServer", "_expected_local_pushes",
+               fences=(FENCE_LIVE_VIEW,)),
+    Transition("global_countdown", "kvstore/server.py",
+               "KVStoreDistServer", "_expected_global_elems",
+               fences=(FENCE_LIVE_VIEW,)),
+    Transition("membership_release", "kvstore/server.py",
+               "KVStoreDistServer", "_on_membership",
+               calls=("_expected_local_pushes", "_expected_global_elems",
+                      "_complete_local_round", "_complete_fsa_round")),
+    Transition("restore_on_recovery", "kvstore/server.py",
+               "KVStoreDistServer", "start",
+               reads=("is_recovery",), calls=("restore",)),
+    # -- recovery (kvstore/replication.py) ------------------------------
+    Transition("restore_merge", "kvstore/replication.py",
+               "ReplicationManager", "restore",
+               calls=("_fetch_from_peer", "_apply")),
+)
+
+#: every protocol verb any transition requires — the extraction records
+#: which of these each anchor calls, so ADDING a vocab call to an anchor
+#: changes its frozen signature too
+_CALL_VOCAB = frozenset(c for t in TRANSITIONS for c in t.calls)
+
+
+# ---------------------------------------------------------------------------
+# extraction
+# ---------------------------------------------------------------------------
+
+def _class_methods(tree: ast.Module) -> Dict[str, Dict[str, ast.AST]]:
+    """class name -> {method name -> def node} (top-level methods)."""
+    out: Dict[str, Dict[str, ast.AST]] = {}
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        out[cls.name] = {
+            n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    return out
+
+
+def _self_store_field(node: ast.AST) -> Optional[str]:
+    """Modeled field stored through ``self``: ``self.f = ...``,
+    ``self.f += ...``, ``self.f[k] = ...``; else None."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and node.attr in MODELED_FIELDS):
+        return node.attr
+    return None
+
+
+def _extract_signature(fn: ast.AST) -> Dict[str, List[str]]:
+    """The anchor's observable protocol surface: modeled-field writes
+    and reads, vocabulary calls, fences."""
+    writes: Set[str] = set()
+    reads: Set[str] = set()
+    calls: Set[str] = set()
+    fences: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                for el in (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                           else [t]):
+                    f = _self_store_field(el)
+                    if f is not None:
+                        writes.add(f)
+        elif isinstance(node, ast.Call):
+            name = call_name(node.func)
+            parts = name.split(".")
+            last = parts[-1] if parts else ""
+            if (last in _MUTATOR_CALLS and len(parts) >= 3
+                    and parts[0] == "self" and parts[1] in MODELED_FIELDS):
+                writes.add(parts[1])
+            if last in _CALL_VOCAB:
+                calls.add(last)
+            if last == "is_stale":
+                fences.add(FENCE_IS_STALE)
+            if last in _LIVE_VIEW_CALLS:
+                fences.add(FENCE_LIVE_VIEW)
+        elif isinstance(node, ast.Compare):
+            for sub in [node.left] + list(node.comparators):
+                for inner in ast.walk(sub):
+                    if (isinstance(inner, ast.Attribute)
+                            and inner.attr == "membership_epoch"):
+                        fences.add(FENCE_EPOCH_GUARD)
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.ctx, ast.Load) \
+                and node.attr in MODELED_FIELDS:
+            reads.add(node.attr)
+    return {"writes": sorted(writes), "calls": sorted(calls),
+            "reads": sorted(reads), "fences": sorted(fences)}
+
+
+def extract_state_model(sources: Sequence[SourceFile]
+                        ) -> Dict[str, Dict[str, dict]]:
+    """rel path -> {"transitions": {name: signature}} for every
+    analyzed file realizing at least one modeled transition."""
+    model: Dict[str, Dict[str, dict]] = {}
+    for src in sources:
+        if src.tree is None:
+            continue
+        hits: Dict[str, dict] = {}
+        classes = None
+        for t in TRANSITIONS:
+            if not src.rel.endswith(t.file):
+                continue
+            if classes is None:
+                classes = _class_methods(src.tree)
+            fn = classes.get(t.cls, {}).get(t.method)
+            if fn is not None:
+                hits[t.name] = _extract_signature(fn)
+        if hits:
+            model[src.rel] = {"transitions": dict(sorted(hits.items()))}
+    return model
+
+
+def state_model_fingerprint(entry: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(entry, sort_keys=True).encode("utf-8")).hexdigest()[:16]
+
+
+def statemodel_lock_path(root: Path) -> Path:
+    return Path(root) / "tools" / "analyze" / "state.lock.json"
+
+
+def write_state_model(sources: Sequence[SourceFile], root: Path) -> Path:
+    """Freeze the current model — the ``--update-state-model`` action."""
+    model = extract_state_model(sources)
+    doc = {
+        "version": 1,
+        "files": {
+            rel: {"fingerprint": state_model_fingerprint(entry), **entry}
+            for rel, entry in sorted(model.items())
+        },
+    }
+    path = statemodel_lock_path(root)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(doc, indent=1, sort_keys=True) + "\n",
+                    encoding="utf-8")
+    return path
+
+
+# ---------------------------------------------------------------------------
+# GX-S501: lock-file drift
+# ---------------------------------------------------------------------------
+
+def _s501_findings(model: Dict[str, dict], root: Path) -> List[Finding]:
+    if not model:
+        return []
+    lock_path = statemodel_lock_path(root)
+    rel_lock = "tools/analyze/state.lock.json"
+    if not lock_path.exists():
+        return [Finding(
+            "GX-S501", SEV_ERROR, rel_lock, 0, symbol="state.lock.json",
+            detail="lock-missing",
+            message=("protocol state-model lock is missing — freeze the "
+                     "current model with `python -m tools.analyze "
+                     "--update-state-model` and commit it"))]
+    try:
+        doc = json.loads(lock_path.read_text(encoding="utf-8"))
+    except ValueError:
+        return [Finding(
+            "GX-S501", SEV_ERROR, rel_lock, 0, symbol="state.lock.json",
+            detail="lock-unreadable",
+            message="state-model lock is not valid json — regenerate it "
+                    "with --update-state-model")]
+    files = doc.get("files", {})
+    out: List[Finding] = []
+    for rel, entry in sorted(model.items()):
+        frozen = files.get(rel)
+        if frozen is None:
+            out.append(Finding(
+                "GX-S501", SEV_ERROR, rel, 0, symbol=rel,
+                detail="entry-missing",
+                message=(f"{rel} realizes modeled protocol transitions "
+                         f"but has no entry in {rel_lock} — run "
+                         f"--update-state-model and commit the diff")))
+        elif frozen.get("fingerprint") != state_model_fingerprint(entry):
+            out.append(Finding(
+                "GX-S501", SEV_ERROR, rel, 0, symbol=rel,
+                detail="model-changed",
+                message=(f"protocol transitions of {rel} drifted from "
+                         f"{rel_lock} (state writes, verb calls or "
+                         f"fences changed) — review the change against "
+                         f"the executable model, re-explore with "
+                         f"tools/modelcheck.py, then --update-state-model "
+                         f"and commit the diff")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GX-S502: modeled fields mutated outside a modeled transition
+# ---------------------------------------------------------------------------
+
+def _s502_findings(src: SourceFile) -> List[Finding]:
+    owner_file, owner_cls = FIELD_OWNER
+    if not src.rel.endswith(owner_file):
+        return []
+    allowed = {t.method for t in TRANSITIONS
+               if t.file == owner_file and t.cls == owner_cls}
+    allowed.add("__init__")
+    out: List[Finding] = []
+    for cls in [n for n in ast.walk(src.tree)
+                if isinstance(n, ast.ClassDef) and n.name == owner_cls]:
+        for m in cls.body:
+            if not isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if m.name in allowed:
+                continue
+            sig = _extract_signature(m)
+            for field in sig["writes"]:
+                out.append(Finding(
+                    "GX-S502", SEV_ERROR, src.rel, m.lineno,
+                    symbol=f"{owner_cls}.{m.name}", detail=field,
+                    message=(f"{owner_cls}.{m.name} mutates modeled "
+                             f"membership state {field!r} outside a "
+                             f"modeled transition — the state model "
+                             f"(and the runtime conformance sanitizer) "
+                             f"cannot see this change; move it into a "
+                             f"modeled transition or extend the model")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GX-S503 / GX-S504: unrealized transitions, missing fences
+# ---------------------------------------------------------------------------
+
+def _s503_s504_findings(src: SourceFile) -> List[Finding]:
+    out: List[Finding] = []
+    classes = None
+    for t in TRANSITIONS:
+        if not src.rel.endswith(t.file):
+            continue
+        if classes is None:
+            classes = _class_methods(src.tree)
+        fn = classes.get(t.cls, {}).get(t.method)
+        symbol = f"{t.cls}.{t.method}"
+        if fn is None:
+            out.append(Finding(
+                "GX-S503", SEV_ERROR, src.rel, 0, symbol=symbol,
+                detail=f"{t.name}:anchor-missing",
+                message=(f"modeled transition {t.name!r} is unreachable: "
+                         f"anchor {symbol} no longer exists in {src.rel} "
+                         f"— retarget the transition in "
+                         f"tools/analyze/statemodel.py or restore the "
+                         f"handler")))
+            continue
+        sig = _extract_signature(fn)
+        missing = (
+            [("write", w) for w in t.writes if w not in sig["writes"]]
+            + [("call", c) for c in t.calls if c not in sig["calls"]]
+            + [("read", r) for r in t.reads if r not in sig["reads"]])
+        for kind, name in missing:
+            out.append(Finding(
+                "GX-S503", SEV_ERROR, src.rel, fn.lineno, symbol=symbol,
+                detail=f"{t.name}:missing-{kind}:{name}",
+                message=(f"modeled transition {t.name!r} is no longer "
+                         f"realized by {symbol}: required {kind} "
+                         f"{name!r} is gone — the code and the "
+                         f"executable model have diverged; fix the "
+                         f"handler or update the model AND re-explore "
+                         f"(tools/modelcheck.py)")))
+        for fence in t.fences:
+            if fence not in sig["fences"]:
+                out.append(Finding(
+                    "GX-S504", SEV_ERROR, src.rel, fn.lineno,
+                    symbol=symbol, detail=f"{t.name}:{fence}",
+                    message=(f"transition {t.name!r} lost its "
+                             f"{fence} fence in {symbol} — zombie "
+                             f"traffic can aggregate / countdowns size "
+                             f"from dead members / stale epochs adopt; "
+                             f"restore the fence (modelcheck's mutation "
+                             f"suite shows the exact invariant this "
+                             f"breaks)")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+def run_statemodel(sources: Sequence[SourceFile],
+                   root: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in sources:
+        if src.tree is None:
+            continue
+        findings += _s502_findings(src)
+        findings += _s503_s504_findings(src)
+    findings += _s501_findings(extract_state_model(sources), Path(root))
+    return findings

@@ -419,3 +419,48 @@ def run_traced(sources: Sequence[SourceFile]) -> List[Finding]:
                              f"codec on device or guard the transfer "
                              f"with is_global_worker")))
     return findings
+
+
+def traced_surface(sources: Sequence[SourceFile]) -> dict:
+    """The surface this pass reasons about, for the unified ``--json``
+    fingerprint stream: per file, the set of jit/pjit/shard_map entry
+    points (the traced-code frontier the GX-J1xx rules walk from)."""
+    out: Dict[str, List[str]] = {}
+    for src in sources:
+        if src.tree is None:
+            continue
+        entries: Set[str] = set()
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call):
+                target, is_jit = _jit_target(node)
+                if is_jit:
+                    entries.add(call_name(target) if target is not None
+                                else "<dynamic>")
+        for fn, qual in _index_functions_flat(src.tree):
+            for deco in fn.decorator_list:
+                name = call_name(deco.func if isinstance(deco, ast.Call)
+                                 else deco)
+                if name in _JIT_NAMES:
+                    entries.add(qual)
+        if entries:
+            out[src.rel] = sorted(entries)
+    return out
+
+
+def _index_functions_flat(tree: ast.Module):
+    """(node, qualname) for every function def, any nesting."""
+    out = []
+
+    def walk(node: ast.AST, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                out.append((child, q))
+                walk(child, f"{q}.<locals>.")
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}{child.name}.")
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out

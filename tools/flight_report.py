@@ -95,7 +95,21 @@ def main(argv: List[str] | None = None) -> int:
                    help="dump file(s) or a GEOMX_FLIGHTREC_DIR")
     p.add_argument("--tail", type=int, default=0,
                    help="show only the last N events per dump")
+    p.add_argument("--conformance", action="store_true",
+                   help="instead of rendering, replay every dump "
+                        "through the protocol state-model checks "
+                        "(tools/modelcheck.py --replay): per-peer epoch "
+                        "monotonicity, strictly increasing declare_dead "
+                        "epochs; exit 1 on any violation")
     args = p.parse_args(argv)
+    if args.conformance:
+        from pathlib import Path
+
+        from tools.modelcheck import replay_paths
+
+        rep = replay_paths([Path(p_) for p_ in args.paths])
+        print(json.dumps(rep, indent=1))
+        return 1 if rep["violations"] or not rep["files"] else 0
     files = _collect(args.paths)
     if not files:
         print("no flight recorder dumps found", file=sys.stderr)

@@ -1,0 +1,944 @@
+"""geomx-modelcheck: small-scope exhaustive exploration of the
+membership/epoch/recovery/round-release protocol model.
+
+This is layer 2 of geomx-statecheck. It imports the SAME executable
+model the lint pass freezes (``tools.analyze.statemodel.MemberView`` /
+``SchedulerView``) and the runtime conformance sanitizer mirrors
+(``geomx_tpu/ps/conformance.py``), and drives it through every
+interleaving of a small cluster — 2-3 workers, 1-2 servers, one
+scheduler — under crash / partition (zombie) / rejoin / retransmit
+schedules, checking safety invariants at every state:
+
+    I1  per-receiver epoch monotonicity: no member ever adopts a
+        membership broadcast with an epoch lower than its view
+    I2  no round aggregates a contribution the dead-set fence should
+        have dropped (sender in the server's dead view)
+    I3  countdown ledgers drain at quiescence: no open round is left
+        waiting on a contribution that can never arrive
+    I4  restore never loses an acked update: a recovering server's
+        restored version covers everything it acknowledged
+    I5  at most one live holder per node id: once the rejoin fence is
+        armed, a previous incarnation's traffic is never aggregated
+    I6  membership views converge at quiescence: every live member's
+        (epoch, dead set) equals the scheduler's
+
+Transport is modeled as per-(src, dst) FIFO links (TCP ordering), with
+nondeterministic interleaving ACROSS links, loss to down/partitioned
+nodes, and bounded retransmission (``dup``: the head of a link is
+re-sent at the tail — a resend racing a newer broadcast, which is how
+cross-epoch reordering happens on a reconnect in the real van).
+
+Exploration is iterative DFS over canonicalized states with a visited
+set and a simple partial-order reduction: when every enabled action is
+a delivery, deliveries to distinct destinations commute (a delivery
+mutates only its destination and never enqueues), so only the smallest
+destination's deliveries are expanded (``--no-por`` disables this; the
+test suite checks both modes reach the same verdict).
+
+Teeth are proved by mutation (``--mutants``): each seeded fence
+removal — dropped rejoin fence, static countdown sizing, restore
+without version comparison, epoch bump without broadcast, dropped
+dead-set fence, stale-broadcast adoption — must trip EXACTLY its
+invariant, nothing more, nothing less.
+
+``--replay`` feeds flight-recorder dumps (``flightrec_*.json``) through
+the model's monotonicity checks offline — the same conformance the
+runtime sanitizer enforces live; ``tools/flight_report.py
+--conformance`` delegates here.
+
+Deliberate simplifications (documented, asserted by scope):
+- replication is synchronous: a released round is on the replica (and
+  acked) immediately; ``tick`` snapshots to disk lazily — exactly the
+  window the restore merge must cover
+- a server crash/rejoin happens at a round boundary (no in-flight
+  pushes to it); mid-push server death is the wire sanitizer's beat
+- a rejoined worker restarts its push schedule; rounds the server
+  already released are skipped (the restored optimizer resumes past
+  them)
+
+Run ``python -m tools.modelcheck`` from the repo root.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from pathlib import Path
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+if __package__ in (None, ""):              # executed as a script
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from tools.analyze.statemodel import MemberView, SchedulerView  # noqa: E402
+
+SCHED = ("c", 0)   # node id of the scheduler in the model
+
+
+INVARIANTS = {
+    "I1": "per-receiver epoch monotonicity",
+    "I2": "no round aggregates a dead-set-fenced contribution",
+    "I3": "countdown ledgers drain at quiescence",
+    "I4": "restore never loses an acked update",
+    "I5": "at most one live holder per node id (rejoin fence holds)",
+    "I6": "membership views converge at quiescence",
+}
+
+#: mutation flags the model honors; each maps to one real fence
+MUTATION_FLAGS = (
+    "no_rejoin_fence",      # server ignores _rejoin_epoch when fencing
+    "no_dead_fence",        # server ignores the dead set when fencing
+    "static_countdown",     # countdown sized from static worker count
+    "restore_snap_first",   # restore prefers snapshot, no version cmp
+    "no_broadcast",         # declare_dead bumps epoch, skips DEAD_NODE
+    "adopt_stale",          # member adopts an older-epoch broadcast
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Scope:
+    """Exploration bounds. Budgets are global over a run, not per
+    node — small scopes keep the state space exhaustive."""
+    workers: int = 2
+    servers: int = 1
+    rounds: int = 1
+    crashes: int = 0        # fail-stop worker crashes
+    partitions: int = 0     # asymmetric partitions (zombies keep sending)
+    rejoins: int = 0        # worker re-registrations (new incarnation)
+    server_crashes: int = 0
+    ticks: int = 0          # lazy snapshot-to-disk events
+    dups: int = 0           # bounded retransmissions of membership frames
+    max_states: int = 400_000
+
+
+#: the clean suite explored by the bare CLI: every schedule class from
+#: the ISSUE (crash, zombie partition, rejoin, server recovery, dup /
+#: reorder via retransmit) at the 2-3 worker / 1-2 server scope
+SCENARIOS: Dict[str, Scope] = {
+    # dup/retransmit coverage lives in the 1-server scopes below: at
+    # 3w2s the retransmit schedules push the space past what an
+    # exhaustive run should cost, without adding a fence they reach
+    "churn-3w2s": Scope(workers=3, servers=2, rounds=1, crashes=1,
+                        rejoins=1),
+    "zombie-rejoin": Scope(workers=2, servers=1, rounds=1, partitions=1,
+                           rejoins=1, dups=1),
+    "zombie-no-rejoin": Scope(workers=2, servers=1, rounds=1,
+                              partitions=1),
+    "crash-before-push": Scope(workers=2, servers=1, rounds=1,
+                               crashes=1),
+    "crash-only": Scope(workers=2, servers=1, rounds=0, crashes=1),
+    "recovery-2r": Scope(workers=2, servers=1, rounds=2,
+                         server_crashes=1, ticks=1),
+    "double-declare": Scope(workers=3, servers=1, rounds=0, crashes=2,
+                            dups=1),
+}
+
+#: mutant name -> (mutation flag, scenario, the ONE invariant it trips)
+MUTANTS: Dict[str, Tuple[str, str, str]] = {
+    "drop_rejoin_fence": ("no_rejoin_fence", "zombie-rejoin", "I5"),
+    "zombie_push_aggregated": ("no_dead_fence", "zombie-no-rejoin",
+                               "I2"),
+    "static_countdown": ("static_countdown", "crash-before-push", "I3"),
+    "restore_no_version_check": ("restore_snap_first", "recovery-2r",
+                                 "I4"),
+    # explored at rounds=0 so the missing broadcast shows up purely as
+    # view divergence — with open rounds it would (correctly) wedge
+    # countdowns too and trip I3 alongside
+    "epoch_bump_without_broadcast": ("no_broadcast", "crash-only",
+                                     "I6"),
+    "stale_broadcast_adopted": ("adopt_stale", "double-declare", "I1"),
+}
+
+
+class ExplosionError(RuntimeError):
+    """The scope exceeded max_states — a scope bug, never truncated
+    silently into a 'clean' verdict."""
+
+
+# ---------------------------------------------------------------------------
+# world state
+# ---------------------------------------------------------------------------
+
+# link keys: (src, dst) where src/dst are SCHED, ("w", wid, inc) or
+# ("s", sid). messages:
+#   ("DEAD", epoch, deadset)          scheduler -> member
+#   ("TABLE", epoch, revivedset)      scheduler -> member
+#   ("PUSH", rnd, wid, inc, epoch)    worker incarnation -> server
+
+
+class World:
+    __slots__ = ("sched", "workers", "zombies", "servers", "links",
+                 "used")
+
+    def __init__(self, scope: Scope):
+        self.sched = SchedulerView()
+        # worker ids 10.., server ids 20..: disjoint, stable
+        self.workers: Dict[int, dict] = {
+            10 + i: {"inc": 0, "up": True, "zombie": False,
+                     "view": MemberView(), "pushed": frozenset()}
+            for i in range(scope.workers)}
+        # previous incarnations that are still partitioned-but-alive
+        self.zombies: Dict[Tuple[int, int], dict] = {}
+        self.servers: Dict[int, dict] = {
+            20 + j: {"up": True, "view": MemberView(),
+                     "ledger": {}, "released": frozenset(),
+                     "version": 0, "snap": 0, "replica": 0, "acked": 0}
+            for j in range(scope.servers)}
+        self.links: Dict[tuple, tuple] = {}
+        self.used = {"crashes": 0, "partitions": 0, "rejoins": 0,
+                     "server_crashes": 0, "ticks": 0, "dups": 0}
+
+    # -- plumbing --------------------------------------------------------
+
+    def clone(self) -> "World":
+        w = World.__new__(World)
+        w.sched = self.sched.copy()
+        w.workers = {wid: {**rec, "view": rec["view"].copy()}
+                     for wid, rec in self.workers.items()}
+        w.zombies = {key: {**rec, "view": rec["view"].copy()}
+                     for key, rec in self.zombies.items()}
+        w.servers = {sid: {**rec, "view": rec["view"].copy(),
+                           "ledger": dict(rec["ledger"])}
+                     for sid, rec in self.servers.items()}
+        w.links = dict(self.links)
+        w.used = dict(self.used)
+        return w
+
+    def canon(self) -> tuple:
+        return (
+            self.sched.snapshot(),
+            # a crashed (non-zombie) worker's view and push history are
+            # unreachable — canonicalize them away so fail-stop branches
+            # merge
+            tuple((wid, r["inc"], r["up"], r["zombie"],
+                   r["view"].snapshot() if r["up"] else (),
+                   tuple(sorted(r["pushed"])) if r["up"] else ())
+                  for wid, r in self.workers.items()),
+            tuple((key, r["view"].snapshot(),
+                   tuple(sorted(r["pushed"])))
+                  for key, r in sorted(self.zombies.items())),
+            tuple((sid, r["up"], r["view"].snapshot(),
+                   tuple(sorted((rnd, tuple(sorted(entries)))
+                                for rnd, entries in
+                                r["ledger"].items())),
+                   tuple(sorted(r["released"])), r["version"],
+                   r["snap"], r["replica"], r["acked"])
+                  for sid, r in self.servers.items()),
+            tuple(sorted((k, v) for k, v in self.links.items() if v)),
+            tuple(sorted(self.used.items())),
+        )
+
+    def enqueue(self, src, dst, msg) -> None:
+        key = (src, dst)
+        self.links[key] = self.links.get(key, ()) + (msg,)
+
+    def member_dsts(self) -> List[tuple]:
+        """Broadcast targets: every up, non-partitioned member the
+        scheduler has not declared dead (``_broadcast_membership``
+        skips the dead set; a partition IS the link being cut)."""
+        out = []
+        for wid, rec in sorted(self.workers.items()):
+            if rec["up"] and not rec["zombie"] \
+                    and wid not in self.sched.dead:
+                out.append(("w", wid, rec["inc"]))
+        for sid, rec in sorted(self.servers.items()):
+            if rec["up"]:
+                out.append(("s", sid))
+        return out
+
+
+@dataclasses.dataclass
+class Violation:
+    invariant: str
+    detail: str
+
+    def key(self) -> Tuple[str, str]:
+        return (self.invariant, self.detail)
+
+
+# ---------------------------------------------------------------------------
+# transition semantics
+# ---------------------------------------------------------------------------
+
+
+class Model:
+    """Action enumeration + application for one (scope, mutations)."""
+
+    def __init__(self, scope: Scope, mutations: FrozenSet[str] = frozenset()):
+        unknown = set(mutations) - set(MUTATION_FLAGS)
+        if unknown:
+            raise ValueError(f"unknown mutation flag(s): {sorted(unknown)}")
+        self.scope = scope
+        self.mut = mutations
+
+    # -- helpers ---------------------------------------------------------
+
+    def _senders(self, w: World):
+        """(wid, inc, view, pushed-getter/setter target dict) for every
+        process that can still emit pushes: up workers (zombie or not)
+        plus superseded zombie incarnations."""
+        for wid, rec in w.workers.items():
+            if rec["up"]:
+                yield wid, rec["inc"], rec
+        for (wid, inc), rec in sorted(w.zombies.items()):
+            yield wid, inc, rec
+
+    def _expected(self, w: World, s: dict) -> int:
+        """Countdown sizing: the live view (``_expected_local_pushes``)
+        or, under the static_countdown mutation, the boot-time count."""
+        if "static_countdown" in self.mut:
+            return max(self.scope.workers, 1)
+        live = [wid for wid in w.workers if wid not in s["view"].dead]
+        return max(len(live), 1)
+
+    def _release_check(self, w: World, s: dict,
+                       out: List[Violation]) -> None:
+        """Re-run every open countdown against the current view —
+        ``_on_membership`` + the aggregate-time check in one place."""
+        for rnd in sorted(s["ledger"]):
+            entries = s["ledger"][rnd]
+            distinct = {wid for wid, _inc, _ep in entries}
+            if len(distinct) >= self._expected(w, s):
+                del s["ledger"][rnd]
+                s["released"] = s["released"] | {rnd}
+                s["version"] += 1
+                # synchronous replication: released == replicated ==
+                # acked; ``tick`` models the lazy disk snapshot
+                s["replica"] = s["version"]
+                s["acked"] = s["version"]
+
+    def _adopt_broadcast(self, view: MemberView, epoch: int, dead,
+                         who: str, out: List[Violation]) -> str:
+        if "adopt_stale" in self.mut and epoch < view.epoch:
+            # seeded removal of the epoch guard in _process_dead_node:
+            # the member regresses to the older broadcast
+            if epoch < view.epoch:
+                out.append(Violation(
+                    "I1", f"{who} adopted epoch {epoch} over "
+                          f"{view.epoch}"))
+            view.epoch = epoch
+            view.dead = set(dead)
+            return "adopt"
+        return view.adopt_broadcast(epoch, dead)
+
+    # -- enumeration -----------------------------------------------------
+
+    def enabled(self, w: World) -> List[tuple]:
+        acts: List[tuple] = []
+        sc, used = self.scope, w.used
+        # deliveries / retransmits
+        for key in sorted(k for k, v in w.links.items() if v):
+            acts.append(("deliver", key))
+            if (used["dups"] < sc.dups
+                    and w.links[key][0][0] in ("DEAD", "SYNC")):
+                acts.append(("dup", key))
+        # pushes: one action per (sender, round), fanning out to every
+        # eligible server at once — the real worker sends its key
+        # pushes back-to-back, and all cross-node races live in the
+        # delivery interleavings anyway (the invariants are per-node)
+        for wid, inc, rec in self._senders(w):
+            for rnd in range(1, sc.rounds + 1):
+                if self._push_targets(w, rec, rnd):
+                    acts.append(("push", wid, inc, rnd))
+        # faults; crash/partition targets are symmetry-reduced: two
+        # "pristine" workers (identical local record, mentioned nowhere
+        # else in the state — no in-flight frame, ledger entry, dead
+        # set or fence names them) leave the whole state invariant
+        # under their swap, so faulting one representative covers both
+        may_fault = (used["crashes"] < sc.crashes
+                     or used["partitions"] < sc.partitions)
+        mentioned = self._mentioned_wids(w) if may_fault else set()
+        fault_classes: set = set()
+        for wid, rec in sorted(w.workers.items()):
+            if rec["up"] and not rec["zombie"]:
+                cls = (rec["inc"], rec["view"].snapshot(),
+                       tuple(sorted(rec["pushed"])))
+                if wid in mentioned:
+                    cls = (wid, cls)     # not swappable: unique class
+                if cls not in fault_classes:
+                    fault_classes.add(cls)
+                    if used["crashes"] < sc.crashes:
+                        acts.append(("crash", wid))
+                    if used["partitions"] < sc.partitions:
+                        acts.append(("partition", wid))
+            if (not rec["up"] or rec["zombie"]) \
+                    and wid not in w.sched.dead:
+                acts.append(("detect", wid))
+            if (not rec["up"] or rec["zombie"]) \
+                    and wid in w.sched.dead \
+                    and used["rejoins"] < sc.rejoins:
+                acts.append(("rejoin", wid))
+        for sid, srv in sorted(w.servers.items()):
+            if srv["up"]:
+                if used["ticks"] < sc.ticks \
+                        and srv["version"] > srv["snap"]:
+                    acts.append(("tick", sid))
+                if used["server_crashes"] < sc.server_crashes \
+                        and not srv["ledger"] \
+                        and not any(v and k[1] == ("s", sid)
+                                    and v[0][0] == "PUSH"
+                                    for k, v in w.links.items()):
+                    acts.append(("crash_server", sid))
+            else:
+                acts.append(("rejoin_server", sid))
+        return acts
+
+    # -- application -----------------------------------------------------
+
+    def apply(self, w: World, act: tuple) -> Tuple[World, List[Violation]]:
+        w = w.clone()
+        out: List[Violation] = []
+        kind = act[0]
+        getattr(self, "_do_" + kind)(w, act, out)
+        self._normalize(w, out)
+        return w, out
+
+    def _mentioned_wids(self, w: World) -> set:
+        """Worker ids named anywhere outside their own record: dead
+        sets, rejoin fences, ledgers, zombie keys, link endpoints and
+        frame payloads. A worker NOT in this set is pristine — the
+        state is invariant under swapping it with an identical one."""
+        out: set = set(w.sched.dead) | set(w.sched.rejoin)
+        for rec in w.workers.values():
+            out |= rec["view"].dead
+            out |= set(rec["view"].rejoin)
+        for (zwid, _inc), rec in w.zombies.items():
+            out.add(zwid)
+            out |= rec["view"].dead
+            out |= set(rec["view"].rejoin)
+        for srv in w.servers.values():
+            out |= srv["view"].dead
+            out |= set(srv["view"].rejoin)
+            for entries in srv["ledger"].values():
+                out |= {e[0] for e in entries}
+        for (src, dst), q in w.links.items():
+            if src[0] == "w":
+                out.add(src[1])
+            if dst[0] == "w":
+                out.add(dst[1])
+            for m in q:
+                if m[0] == "PUSH":
+                    out.add(m[2])
+                elif m[0] == "DEAD":
+                    out |= set(m[2])
+                else:               # SYNC
+                    out |= set(m[2]) | set(m[3])
+        return out
+
+    def _done_sending(self, w: World, rec: dict) -> bool:
+        """True when this worker can never emit another push: every
+        (server, round) is either already pushed or released without
+        it (a released round never un-releases)."""
+        for sid, srv in w.servers.items():
+            for rnd in range(1, self.scope.rounds + 1):
+                if (sid, rnd) not in rec["pushed"] \
+                        and rnd not in srv["released"]:
+                    return False
+        return True
+
+    def _normalize(self, w: World, out: List[Violation]) -> None:
+        """Two sound state-space reductions applied after every action:
+
+        - drop in-flight messages whose destination can never process
+          them (crashed / partitioned / superseded / down) — delivering
+          each would be a no-op pop
+        - eagerly drain broadcasts to a worker that is done sending:
+          from then on its view is write-only (it stamps no more
+          pushes), so the adoption commutes with every other action
+          and delaying it only multiplies interleavings (I1 still
+          checks on the eager adoption; I6 still checks at terminal)
+        """
+        for key in list(w.links):
+            dst = key[1]
+            if dst[0] != "w":
+                if not w.servers[dst[1]]["up"]:
+                    del w.links[key]
+                continue
+            rec = w.workers.get(dst[1])
+            if (rec is None or rec["inc"] != dst[2]
+                    or not rec["up"] or rec["zombie"]):
+                del w.links[key]
+            elif self._done_sending(w, rec):
+                for msg in w.links.pop(key):
+                    if msg[0] == "DEAD":
+                        self._adopt_broadcast(
+                            rec["view"], msg[1], msg[2],
+                            f"worker {dst[1]}", out)
+                    elif msg[0] == "SYNC":
+                        rec["view"].adopt_table(msg[1], msg[2])
+                        self._adopt_broadcast(
+                            rec["view"], msg[1], msg[3],
+                            f"worker {dst[1]}", out)
+
+    def _push_targets(self, w: World, rec: dict,
+                      rnd: int) -> List[int]:
+        """Servers this sender still owes round ``rnd``: up, not yet
+        pushed, round not already released without it (elastic release
+        / restarted incarnation resumes past it), previous round
+        released."""
+        out = []
+        for sid, srv in sorted(w.servers.items()):
+            if not srv["up"] or (sid, rnd) in rec["pushed"]:
+                continue
+            if rnd in srv["released"]:
+                continue
+            if rnd > 1 and (rnd - 1) not in srv["released"]:
+                continue
+            out.append(sid)
+        return out
+
+    def _do_push(self, w, act, out):
+        _, wid, inc, rnd = act
+        rec = (w.workers[wid] if w.workers[wid]["inc"] == inc
+               else w.zombies[(wid, inc)])
+        targets = self._push_targets(w, rec, rnd)
+        rec["pushed"] = frozenset(rec["pushed"]) | {
+            (sid, rnd) for sid in targets}
+        for sid in targets:
+            w.enqueue(("w", wid, inc), ("s", sid),
+                      ("PUSH", rnd, wid, inc, rec["view"].epoch))
+
+    def _do_crash(self, w, act, out):
+        w.workers[act[1]]["up"] = False
+        w.used["crashes"] += 1
+
+    def _do_partition(self, w, act, out):
+        w.workers[act[1]]["zombie"] = True
+        w.used["partitions"] += 1
+
+    def _do_detect(self, w, act, out):
+        wid = act[1]
+        res = w.sched.declare_dead([wid])
+        if res is None:
+            return
+        epoch, dead = res
+        if "no_broadcast" in self.mut:
+            return          # seeded removal of _broadcast_membership
+        for dst in w.member_dsts():
+            w.enqueue(SCHED, dst, ("DEAD", epoch, dead))
+
+    def _do_rejoin(self, w, act, out):
+        wid = act[1]
+        rec = w.workers[wid]
+        epoch = w.sched.revive(wid)
+        if rec["zombie"]:
+            # the old incarnation is still out there, still pushing
+            w.zombies[(wid, rec["inc"])] = {
+                "view": rec["view"], "pushed": rec["pushed"]}
+        # new incarnation: registration hands it the current table
+        w.workers[wid] = {"inc": rec["inc"] + 1, "up": True,
+                          "zombie": False,
+                          "view": MemberView(w.sched.epoch,
+                                             w.sched.dead,
+                                             w.sched.rejoin),
+                          "pushed": frozenset()}
+        w.used["rejoins"] += 1
+        # _scheduler_register: table re-broadcast immediately followed
+        # by the DEAD_NODE full-set broadcast on the same FIFO link —
+        # modeled as one SYNC frame (a member processes the pair with
+        # nothing of its own in between; both are idempotent)
+        dead = frozenset(w.sched.dead)
+        for dst in w.member_dsts():
+            if dst == ("w", wid, rec["inc"] + 1):
+                continue    # the newcomer got the table synchronously
+            w.enqueue(SCHED, dst,
+                      ("SYNC", epoch, frozenset([wid]), dead))
+
+    def _do_dup(self, w, act, out):
+        key = act[1]
+        q = w.links[key]
+        # a retransmit: the head frame is re-sent at the tail, so it
+        # arrives AFTER broadcasts that were queued behind it
+        w.links[key] = q + (q[0],)
+        w.used["dups"] += 1
+
+    def _do_tick(self, w, act, out):
+        srv = w.servers[act[1]]
+        srv["snap"] = srv["version"]
+        w.used["ticks"] += 1
+
+    def _do_crash_server(self, w, act, out):
+        w.servers[act[1]]["up"] = False
+        w.used["server_crashes"] += 1
+
+    def _do_rejoin_server(self, w, act, out):
+        srv = w.servers[act[1]]
+        if "restore_snap_first" in self.mut:
+            # seeded removal of the version comparison: the snapshot
+            # file wins whenever it exists
+            restored = srv["snap"] if srv["snap"] > 0 else srv["replica"]
+        else:
+            restored = max(srv["snap"], srv["replica"])
+        if restored < srv["acked"]:
+            out.append(Violation(
+                "I4", f"server restored v{restored} after acking "
+                      f"v{srv['acked']}"))
+        srv["up"] = True
+        srv["version"] = restored
+        srv["ledger"] = {}
+        srv["view"] = MemberView(w.sched.epoch, w.sched.dead,
+                                 w.sched.rejoin)
+
+    def _do_deliver(self, w, act, out):
+        key = act[1]
+        src, dst = key
+        q = w.links[key]
+        msg, w.links[key] = q[0], q[1:]
+        if dst[0] == "w":
+            _, wid, inc = dst
+            rec = w.workers.get(wid)
+            if (rec is None or rec["inc"] != inc or not rec["up"]
+                    or rec["zombie"]):
+                return      # lost: crashed / partitioned / superseded
+            if msg[0] == "DEAD":
+                self._adopt_broadcast(rec["view"], msg[1], msg[2],
+                                      f"worker {wid}", out)
+            elif msg[0] == "SYNC":
+                rec["view"].adopt_table(msg[1], msg[2])
+                self._adopt_broadcast(rec["view"], msg[1], msg[3],
+                                      f"worker {wid}", out)
+            return
+        sid = dst[1]
+        srv = w.servers[sid]
+        if not srv["up"]:
+            return          # lost: the rejoin re-registration resyncs
+        if msg[0] == "DEAD":
+            if self._adopt_broadcast(srv["view"], msg[1], msg[2],
+                                     f"server {sid}", out) == "adopt":
+                self._release_check(w, srv, out)
+            return
+        if msg[0] == "SYNC":
+            changed = srv["view"].adopt_table(msg[1], msg[2])
+            adopted = self._adopt_broadcast(srv["view"], msg[1],
+                                            msg[3], f"server {sid}",
+                                            out) == "adopt"
+            if changed or adopted:
+                self._release_check(w, srv, out)
+            return
+        # PUSH
+        _, rnd, wid, inc, epoch = msg
+        stale_dead = wid in srv["view"].dead
+        stale_rejoin = epoch < srv["view"].rejoin.get(wid, 0)
+        fenced = ((stale_dead and "no_dead_fence" not in self.mut)
+                  or (stale_rejoin
+                      and "no_rejoin_fence" not in self.mut))
+        if fenced:
+            rec = w.workers.get(wid)
+            if (rec is not None and rec["inc"] == inc and rec["up"]
+                    and not rec["zombie"]):
+                # dropped WITHOUT ack: a live sender's resender keeps
+                # retrying until the server's view catches up with the
+                # revival (the fence is only a drop, never a nack) —
+                # re-queue at the tail
+                w.links[key] = w.links[key] + (msg,)
+            # a zombie / superseded incarnation gives up when it
+            # learns of its own death: the push is gone for good
+            return
+        if stale_dead:
+            out.append(Violation(
+                "I2", f"server {sid} aggregated a push from dead "
+                      f"worker {wid}"))
+        if stale_rejoin:
+            out.append(Violation(
+                "I5", f"server {sid} aggregated incarnation {inc} of "
+                      f"worker {wid} past its rejoin fence "
+                      f"(epoch {epoch} < "
+                      f"{srv['view'].rejoin.get(wid, 0)})"))
+        if rnd in srv["released"]:
+            return          # late push to a completed round: re-acked
+        srv["ledger"][rnd] = srv["ledger"].get(rnd, ()) + (
+            (wid, inc, epoch),)
+        self._release_check(w, srv, out)
+
+    # -- terminal checks -------------------------------------------------
+
+    def at_quiescence(self, w: World) -> List[Violation]:
+        out: List[Violation] = []
+        for sid, srv in sorted(w.servers.items()):
+            if srv["up"] and srv["ledger"]:
+                out.append(Violation(
+                    "I3", f"server {sid} quiesced with open round(s) "
+                          f"{sorted(srv['ledger'])} "
+                          f"(view epoch {srv['view'].epoch})"))
+        want = (w.sched.epoch, tuple(sorted(w.sched.dead)))
+        for wid, rec in sorted(w.workers.items()):
+            if rec["up"] and not rec["zombie"]:
+                got = (rec["view"].epoch,
+                       tuple(sorted(rec["view"].dead)))
+                if got != want:
+                    out.append(Violation(
+                        "I6", f"worker {wid} quiesced at {got}, "
+                              f"scheduler at {want}"))
+        for sid, srv in sorted(w.servers.items()):
+            if srv["up"]:
+                got = (srv["view"].epoch,
+                       tuple(sorted(srv["view"].dead)))
+                if got != want:
+                    out.append(Violation(
+                        "I6", f"server {sid} quiesced at {got}, "
+                              f"scheduler at {want}"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# explorer
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Result:
+    scenario: str
+    mutations: tuple
+    states: int
+    transitions: int
+    terminals: int
+    violations: List[Violation]
+
+    @property
+    def invariants_hit(self) -> List[str]:
+        return sorted({v.invariant for v in self.violations})
+
+    def to_json(self) -> dict:
+        return {"scenario": self.scenario,
+                "mutations": list(self.mutations),
+                "states": self.states,
+                "transitions": self.transitions,
+                "terminals": self.terminals,
+                "invariants_hit": self.invariants_hit,
+                "violations": [{"invariant": v.invariant,
+                                "detail": v.detail}
+                               for v in self.violations[:20]]}
+
+
+def explore(scope: Scope, mutations: FrozenSet[str] = frozenset(),
+            por: bool = True, scenario: str = "") -> Result:
+    """Exhaustive DFS from the initial world. A violating branch is
+    recorded and pruned (the protocol is already broken there);
+    distinct (invariant, detail) pairs are kept."""
+    model = Model(scope, mutations)
+    root = World(scope)
+    seen = {root.canon()}
+    stack = [root]
+    states = transitions = terminals = 0
+    violations: List[Violation] = []
+    vseen: set = set()
+
+    def note(vs: Sequence[Violation]) -> None:
+        for v in vs:
+            if v.key() not in vseen:
+                vseen.add(v.key())
+                violations.append(v)
+
+    while stack:
+        w = stack.pop()
+        states += 1
+        if states > scope.max_states:
+            raise ExplosionError(
+                f"{scenario or 'scope'}: exceeded max_states="
+                f"{scope.max_states}")
+        acts = model.enabled(w)
+        if not acts:
+            terminals += 1
+            note(model.at_quiescence(w))
+            continue
+        if por and all(a[0] == "deliver" for a in acts):
+            # all-delivery states: deliveries to distinct destinations
+            # commute (each mutates only its destination, none
+            # enqueues), so expanding one destination suffices
+            dst_min = min(a[1][1] for a in acts)
+            acts = [a for a in acts if a[1][1] == dst_min]
+        for act in acts:
+            nxt, vs = model.apply(w, act)
+            transitions += 1
+            if vs:
+                note(vs)
+                continue    # prune: already off the protocol
+            c = nxt.canon()
+            if c not in seen:
+                seen.add(c)
+                stack.append(nxt)
+    return Result(scenario, tuple(sorted(mutations)), states,
+                  transitions, terminals, violations)
+
+
+def run_clean(por: bool = True, only: Optional[str] = None,
+              scenarios: Optional[Dict[str, Scope]] = None
+              ) -> Dict[str, Result]:
+    out = {}
+    for name, scope in (scenarios or SCENARIOS).items():
+        if only is not None and name != only:
+            continue
+        out[name] = explore(scope, frozenset(), por=por, scenario=name)
+    return out
+
+
+def run_mutants(por: bool = True,
+                scenarios: Optional[Dict[str, Scope]] = None
+                ) -> Dict[str, Tuple[Result, str]]:
+    """Each mutant explored under its scenario; the caller checks the
+    hit-set equals exactly {expected}."""
+    scenarios = scenarios or SCENARIOS
+    out = {}
+    for name, (flag, scenario, expected) in MUTANTS.items():
+        res = explore(scenarios[scenario], frozenset([flag]), por=por,
+                      scenario=scenario)
+        out[name] = (res, expected)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# replay: flight-recorder dumps through the model's monotonicity checks
+# ---------------------------------------------------------------------------
+
+
+def replay_events(events: Sequence[dict]) -> List[str]:
+    """Offline conformance over one dump's event ring: per-peer wire
+    epochs never regress, scheduler declare_dead epochs strictly
+    increase, and the recorded dead set only shrinks on a revival
+    (exactly what the runtime sanitizer latches live)."""
+    problems: List[str] = []
+    wire_epoch: Dict[int, int] = {}
+    decl_epoch = 0
+    for ev in sorted(events, key=lambda e: e.get("seq", 0)):
+        kind = ev.get("kind")
+        if kind in ("sent", "recv"):
+            peer = ev.get("peer")
+            epoch = ev.get("epoch") or 0
+            if peer is None or epoch <= 0:
+                continue
+            last = wire_epoch.get(peer, 0)
+            if kind == "recv" and epoch < last:
+                problems.append(
+                    f"seq {ev.get('seq')}: recv from peer {peer} at "
+                    f"epoch {epoch} after seeing {last}")
+            wire_epoch[peer] = max(last, epoch)
+        elif kind == "membership" \
+                and ev.get("event") == "declare_dead":
+            epoch = ev.get("epoch") or 0
+            if epoch <= decl_epoch:
+                problems.append(
+                    f"seq {ev.get('seq')}: declare_dead epoch {epoch} "
+                    f"not above {decl_epoch}")
+            decl_epoch = max(decl_epoch, epoch)
+    return problems
+
+
+def replay_paths(paths: Sequence[Path]) -> dict:
+    """Replay every ``flightrec_*.json`` under the given files/dirs."""
+    files: List[Path] = []
+    for p in paths:
+        if p.is_dir():
+            files.extend(sorted(p.glob("flightrec_*.json")))
+        elif p.exists():
+            files.append(p)
+    report = {"files": [], "violations": 0}
+    for f in files:
+        try:
+            dump = json.loads(f.read_text(encoding="utf-8"))
+        except (OSError, ValueError) as exc:
+            report["files"].append({"path": str(f),
+                                    "error": str(exc)})
+            continue
+        problems = replay_events(dump.get("events", []))
+        report["violations"] += len(problems)
+        report["files"].append({"path": str(f),
+                                "node": dump.get("node"),
+                                "events": len(dump.get("events", [])),
+                                "problems": problems})
+    return report
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.modelcheck",
+        description="small-scope exploration of the geomx-statecheck "
+                    "protocol model (docs/static-analysis.md)")
+    ap.add_argument("--scenario", default=None,
+                    help="explore one scenario from: %s"
+                         % ",".join(SCENARIOS))
+    ap.add_argument("--mutants", action="store_true",
+                    help="run the mutation suite: each seeded fence "
+                         "removal must trip exactly its invariant")
+    ap.add_argument("--replay", nargs="+", metavar="PATH",
+                    help="replay flightrec_*.json dumps (files or "
+                         "dirs) through the model's conformance "
+                         "checks instead of exploring")
+    ap.add_argument("--no-por", action="store_true",
+                    help="disable partial-order reduction")
+    ap.add_argument("--max-states", type=int, default=None,
+                    help="override the per-scenario state cap")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable results")
+    args = ap.parse_args(argv)
+    por = not args.no_por
+
+    if args.replay:
+        report = replay_paths([Path(p) for p in args.replay])
+        if args.json:
+            print(json.dumps(report, indent=1))
+        else:
+            for f in report["files"]:
+                tag = (f"ERROR {f['error']}" if "error" in f else
+                       f"{f['events']} events, "
+                       f"{len(f['problems'])} problem(s)")
+                print(f"{f['path']}: {tag}")
+                for p in f.get("problems", []):
+                    print(f"  VIOLATION {p}")
+            print(f"{len(report['files'])} dump(s), "
+                  f"{report['violations']} violation(s)")
+        return 1 if report["violations"] else 0
+
+    if args.scenario is not None and args.scenario not in SCENARIOS:
+        print(f"unknown scenario: {args.scenario}", file=sys.stderr)
+        return 2
+
+    scenarios = SCENARIOS
+    if args.max_states is not None:
+        scenarios = {n: dataclasses.replace(s,
+                                            max_states=args.max_states)
+                     for n, s in SCENARIOS.items()}
+
+    rc = 0
+    payload = {"clean": {}, "mutants": {}}
+    if not args.mutants:
+        for name, res in run_clean(por=por, only=args.scenario,
+                                   scenarios=scenarios).items():
+            ok = not res.violations
+            payload["clean"][name] = res.to_json()
+            if not args.json:
+                print(f"{'OK  ' if ok else 'FAIL'} {name}: "
+                      f"{res.states} states, {res.transitions} "
+                      f"transitions, {res.terminals} terminal(s)"
+                      + ("" if ok else
+                         f" — invariants {res.invariants_hit}"))
+                for v in res.violations[:5]:
+                    print(f"      {v.invariant}: {v.detail}")
+            if not ok:
+                rc = 1
+    if args.mutants:
+        for name, (res, expected) in run_mutants(
+                por=por, scenarios=scenarios).items():
+            hit = res.invariants_hit
+            ok = hit == [expected]
+            payload["mutants"][name] = {**res.to_json(),
+                                        "expected": expected,
+                                        "ok": ok}
+            if not args.json:
+                print(f"{'OK  ' if ok else 'FAIL'} mutant {name}: "
+                      f"expected [{expected}] tripped {hit} "
+                      f"({res.states} states)")
+            if not ok:
+                rc = 1
+    if args.json:
+        print(json.dumps(payload, indent=1))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
